@@ -1,0 +1,277 @@
+"""Configuration dataclasses + CLI override system.
+
+Reference parity: `LLMconfig` (reference single-gpu/model.py:39-75) and
+`Trainconfig` (reference single-gpu/train.py:29-44), plus the ~33-flag
+argparse CLI and the generic "setattr onto whichever dataclass owns the
+name" override loop (reference single-gpu/train.py:136-206). TPU-first
+deltas:
+
+* configs are frozen (hashable) so they can be closed over by `jax.jit`
+  without retracing hazards; CLI overrides produce new instances via
+  `dataclasses.replace` instead of mutating defaults in place.
+* `TrainConfig` grows TPU-native fields the reference spreads across five
+  separate trainer scripts: `parallelism` (the named sharding recipe that
+  replaces the reference's single/ddp/zero1/zero2/fsdp entry points),
+  mesh axis sizes, and the compute dtype (bf16 on TPU; the reference's
+  fp16 GradScaler machinery is unnecessary on TPU and intentionally
+  absent — see SURVEY.md §5 "Mixed precision").
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from dataclasses import dataclass
+from typing import Literal, Optional
+
+ACTIVATIONS = (
+    "relu", "gelu", "swish", "mish", "silu", "selu", "celu", "elu",
+    "glu", "sigmoid", "lrelu", "tanh", "swiglu",
+)
+
+ATTENTION_KINDS = ("mha", "mqa", "gqa", "mla")
+POS_EMB_KINDS = ("learn", "sin", "rope")
+# The reference realizes these as five separate trainer scripts
+# (single-gpu/train.py, multi-gpu/ddp/train.py, kaggle-zero1.py,
+# kaggle-zero2.py, kaggle-fsdp.py); here each is a sharding recipe name.
+# 'tp', 'ep', 'sp', and combinations exceed the reference (its README.md:7
+# names them as unrealized goals).
+PARALLELISM_RECIPES = (
+    "single", "dp", "zero1", "zero2", "fsdp", "tp", "fsdp_tp", "ep", "sp",
+)
+
+
+@dataclass(frozen=True)
+class LLMConfig:
+    """Model hyperparameters. Mirrors reference `LLMconfig` field-for-field
+    (single-gpu/model.py:39-75); frozen+hashable for jit."""
+
+    # token params
+    vocab_size: int = 50304
+    block_size: int = 1024
+    n_embd: int = 256
+    pos_emb: str = "rope"  # Literal['learn','sin','rope']
+
+    # feed-forward network
+    up_dim: int = 384
+    non_linearity: str = "swiglu"  # see ACTIVATIONS
+    dropout: float = 0.0
+    n_layer: int = 6
+
+    # MoE (DeepSeekMoE; reference single-gpu/model.py:409-506)
+    moe: bool = False
+    n_exp: int = 16
+    n_shared: int = 2
+    n_act: int = 8          # INCLUDES the shared experts
+    coeff: float = 0.01     # classic aux-loss coefficient
+    aux_free: bool = True   # aux-loss-free balancing (bias-based)
+    alpha: float = 1e-4     # complementary seq-wise aux loss coeff
+    gamma: float = 1e-3     # bias update speed
+
+    # attention
+    attn: str = "gqa"  # Literal['mha','mqa','gqa','mla']
+    n_head: int = 8
+    n_kv_heads: int = 4
+    # MLA only (defaults match reference ModelConfig, train.py:128-131, so
+    # `--attn mla` works out of the box):
+    q_latent_dim: Optional[int] = 32
+    kv_latent_dim: Optional[int] = 32
+    rope_head_dim: Optional[int] = 16
+
+    # memory subsystem: selective activation recomputation (jax.remat)
+    act_recomp: bool = False
+
+    def __post_init__(self):
+        # Cross-field normalization, mirroring reference
+        # single-gpu/train.py:198-206 (mha -> n_kv_heads=n_head, mqa -> 1,
+        # mla requires latent dims; rope-mla additionally rope_head_dim).
+        if self.attn == "mha":
+            object.__setattr__(self, "n_kv_heads", self.n_head)
+        elif self.attn == "mqa":
+            object.__setattr__(self, "n_kv_heads", 1)
+        elif self.attn == "gqa":
+            assert self.n_head % self.n_kv_heads == 0, \
+                "n_head must be divisible by n_kv_heads"
+        elif self.attn == "mla":
+            assert self.q_latent_dim is not None and self.kv_latent_dim is not None, \
+                "Either q_latent_dim or kv_latent_dim is missing"
+            if self.pos_emb == "rope":
+                assert self.rope_head_dim is not None, "Need dim of Rotary heads"
+        else:
+            raise ValueError(f"unknown attention kind {self.attn!r}")
+        assert self.n_embd % self.n_head == 0, "n_embd must be divisible by n_head"
+        assert self.pos_emb in POS_EMB_KINDS, f"unknown pos_emb {self.pos_emb!r}"
+        assert self.non_linearity.lower() in ACTIVATIONS, \
+            f"unknown non_linearity {self.non_linearity!r}"
+        if self.moe:
+            assert self.n_act > self.n_shared, \
+                "Number of active experts must be greater than shared experts"
+            assert self.n_exp > self.n_shared
+            assert self.n_act <= self.n_exp, \
+                "n_act (which includes shared experts) cannot exceed n_exp"
+
+    @property
+    def head_size(self) -> int:
+        return self.n_embd // self.n_head
+
+    @property
+    def n_routed(self) -> int:
+        return self.n_exp - self.n_shared
+
+    @property
+    def n_act_routed(self) -> int:
+        return self.n_act - self.n_shared
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Training hyperparameters. Mirrors reference `Trainconfig`
+    (single-gpu/train.py:29-44) plus TPU-native parallelism fields."""
+
+    dataset: str = "tinystories"  # Literal['shakespeare','tinystories','fineweb']
+    data_dir: str = "data"
+    total_batch_size: int = 2 ** 11   # in tokens
+    batch_size: int = 2              # micro-batch size (sequences)
+    max_iters: int = 2500
+    eval: bool = False
+    eval_interval: int = 100
+    eval_iters: int = 100
+    learning_rate: float = 3e-4
+    warmup_steps: int = 100
+    weight_decay: float = 0.1
+    grad_clip: float = 1.0
+    save_model: bool = False
+    file_name: str = "llm_model"
+    act_recomp: bool = False
+    seed: int = 1729
+
+    # --- TPU-native fields (no reference equivalent; replace the reference's
+    # per-script hardcoding of AMP dtype and torchrun world topology) ---
+    parallelism: str = "single"      # see PARALLELISM_RECIPES
+    dp_size: int = -1                # -1: infer from device count
+    tp_size: int = 1                 # model axis size (tp / fsdp_tp)
+    ep_size: int = 1                 # expert axis size (ep)
+    sp_size: int = 1                 # sequence axis size (sp / ring attention)
+    compute_dtype: str = "bfloat16"  # bf16 compute, fp32 params/opt state
+    # attention kernel choice; ring attention is selected via the 'sp'
+    # parallelism recipe (a sharding concern), not here
+    attn_impl: str = "auto"          # 'auto' | 'xla' | 'pallas' | 'naive'
+    moe_impl: str = "dense"          # 'dense' | 'scatter'
+    # checkpoint/resume (exceeds reference save-only; SURVEY.md §5)
+    ckpt_interval: int = 0           # 0 = end-of-run only
+    resume: bool = False
+    log_interval: int = 1
+    profile: bool = False            # jax.profiler trace capture
+
+    def __post_init__(self):
+        assert self.parallelism in PARALLELISM_RECIPES, \
+            f"unknown parallelism recipe {self.parallelism!r}"
+        assert self.moe_impl in ("dense",), \
+            "moe_impl 'scatter' (capacity-bounded sort dispatch) is planned " \
+            "but not yet implemented; use 'dense'"
+        assert self.attn_impl in ("auto", "xla", "pallas", "naive"), \
+            f"unknown attn_impl {self.attn_impl!r}"
+
+
+# ---------------------------------------------------------------------------
+# CLI override system (reference single-gpu/train.py:136-206): one flag per
+# dataclass field, routed generically to whichever config owns the name.
+# ---------------------------------------------------------------------------
+
+_BOOL_FLAGS = {
+    # reference store_true flags (single-gpu/train.py:176-180)
+    "moe", "aux_free", "eval", "save_model", "act_recomp",
+    # new
+    "resume", "profile",
+}
+
+
+def build_parser(model_defaults: LLMConfig | None = None,
+                 train_defaults: TrainConfig | None = None) -> argparse.ArgumentParser:
+    """Build an argparse parser exposing every field of both dataclasses.
+
+    Mirrors reference parse_args() (single-gpu/train.py:136-181) including
+    `--total_batch_size_str`, which accepts an expression like "2**14"
+    (evaluated arithmetically, reference train.py:186-188)."""
+    model_defaults = model_defaults or LLMConfig()
+    train_defaults = train_defaults or TrainConfig()
+    p = argparse.ArgumentParser(description="Train an LLM on TPU (JAX/XLA)")
+
+    seen: set[str] = set()
+    for cfg in (train_defaults, model_defaults):
+        for f in dataclasses.fields(cfg):
+            name = f.name
+            if name in seen:  # act_recomp lives in both configs
+                continue
+            seen.add(name)
+            if name == "total_batch_size":
+                p.add_argument("--total_batch_size_str", type=str,
+                               default=str(train_defaults.total_batch_size),
+                               help="Total batch size in tokens, as an arithmetic "
+                                    "expression, e.g. '2**14'")
+                continue
+            default = getattr(cfg, name)
+            if name in _BOOL_FLAGS:
+                if default:
+                    # store_true can never turn a default-True flag off;
+                    # expose --name / --no-name instead (e.g. --no-aux_free)
+                    p.add_argument(f"--{name}", default=default,
+                                   action=argparse.BooleanOptionalAction)
+                else:
+                    p.add_argument(f"--{name}", action="store_true",
+                                   default=default)
+            elif f.type in ("int", "Optional[int]", int):
+                p.add_argument(f"--{name}", type=int, default=default)
+            elif f.type in ("float", float):
+                p.add_argument(f"--{name}", type=float, default=default)
+            else:
+                p.add_argument(f"--{name}", type=str, default=default)
+    return p
+
+
+def _safe_int_expr(s: str) -> int:
+    """Arithmetic-only replacement for the reference's bare eval()
+    (single-gpu/train.py:186-188)."""
+    import ast
+    node = ast.parse(s, mode="eval")
+    allowed = (ast.Expression, ast.BinOp, ast.UnaryOp, ast.Constant,
+               ast.Add, ast.Sub, ast.Mult, ast.Div, ast.FloorDiv, ast.Pow,
+               ast.USub, ast.Mod)
+    for n in ast.walk(node):
+        if not isinstance(n, allowed):
+            raise ValueError(f"disallowed expression: {s!r}")
+    return int(eval(compile(node, "<total_batch_size_str>", "eval")))  # noqa: S307
+
+
+def configs_from_args(args: argparse.Namespace,
+                      model_defaults: LLMConfig | None = None,
+                      train_defaults: TrainConfig | None = None,
+                      ) -> tuple[LLMConfig, TrainConfig]:
+    """Route parsed flags onto the owning dataclass (reference
+    single-gpu/train.py:183-197): strings lowercased except
+    `non_linearity` and paths; act_recomp is copied into the model config
+    (reference train.py:189-190)."""
+    model_defaults = model_defaults or LLMConfig()
+    train_defaults = train_defaults or TrainConfig()
+    model_fields = {f.name for f in dataclasses.fields(LLMConfig)}
+    train_fields = {f.name for f in dataclasses.fields(TrainConfig)}
+    no_lower = {"non_linearity", "file_name", "data_dir"}
+
+    m_kw, t_kw = {}, {}
+    for key, value in vars(args).items():
+        if key == "total_batch_size_str":
+            t_kw["total_batch_size"] = _safe_int_expr(value)
+            continue
+        if isinstance(value, str) and key not in no_lower:
+            value = value.lower().strip()
+        if key in train_fields:
+            t_kw[key] = value
+        if key in model_fields:
+            m_kw[key] = value
+    # act_recomp lives in both configs; train's flag wins (reference
+    # train.py:189-190 links them).
+    if "act_recomp" in t_kw:
+        m_kw["act_recomp"] = t_kw["act_recomp"]
+    model = dataclasses.replace(model_defaults, **m_kw)
+    train = dataclasses.replace(train_defaults, **t_kw)
+    return model, train

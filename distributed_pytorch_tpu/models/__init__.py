@@ -1,0 +1,7 @@
+"""Model library: one canonical Flax implementation of the reference's LLM
+family (reference single-gpu/model.py — which the reference duplicates four
+more times inside its kaggle scripts; here it exists exactly once)."""
+
+from distributed_pytorch_tpu.models.gpt import LLM, Block, init_cache  # noqa: F401
+from distributed_pytorch_tpu.models.attention import GQA, NaiveMLA, FullMLA, Attention  # noqa: F401
+from distributed_pytorch_tpu.models.mlp import MLP, MoE  # noqa: F401

@@ -1,0 +1,189 @@
+"""The LLM: token/positional embeddings, pre-LN transformer blocks, weight-
+tied LM head, CE loss with MoE aux-loss accumulation, KV-cached decoding.
+
+Reference parity map (single-gpu/model.py):
+* `Block` — :508-533: pre-LN attention + (MLP | MoE) with residuals; returns
+  (x, cache, aux_loss), aux_loss = 0.0 for dense blocks (:530).
+* `LLM`   — :535-747: token embedding + one of three positional schemes
+  (:541-552: 'learn' = learned table, 'sin' = fixed sinusoidal buffer,
+  'rope' = precomputed rotary angles), dropout, n_layer blocks, final LN,
+  weight-tied lm_head (:559-560), N(0, 0.02) init for all dense/embedding
+  weights (:579-586), forward with cache-offset start_pos (:641-650),
+  per-layer aux-loss accumulation added as total_aux/n_layer (:687-692),
+  last-position-only logits when targets are absent (:694).
+
+TPU-first notes:
+* Parameters are fp32; compute runs in `compute_dtype` (bf16 on TPU) — pure
+  bf16 matmuls with fp32 master weights replaces the reference's
+  fp16 autocast + GradScaler (SURVEY §5 mixed-precision divergence).
+* `act_recomp` wraps each Block in `nn.remat` (reference wraps Blocks in
+  torch checkpoint, model.py:677-680), trading FLOPs for HBM.
+* Caches are fixed-size buffers + a `pos` index (XLA static shapes), created
+  by `init_cache`; `pos` replaces the reference's len-of-cache start_pos.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from distributed_pytorch_tpu.config import LLMConfig
+from distributed_pytorch_tpu.models.attention import Attention, init_attn_cache
+from distributed_pytorch_tpu.models.mlp import MLP, MoE
+from distributed_pytorch_tpu.ops.rope import precompute_rope_freqs, slice_rows
+
+_EMBED_INIT = nn.initializers.normal(stddev=0.02)
+
+
+class Block(nn.Module):
+    """Pre-LN transformer block (reference model.py:508-533).
+
+    `deterministic` is a module attribute (not a call arg) so the whole
+    block can be wrapped in `nn.remat` without static-argnum plumbing."""
+
+    config: LLMConfig
+    attn_impl: str = "auto"
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x, freqs, cache=None, pos=0):
+        cfg = self.config
+        deterministic = self.deterministic
+        ln1 = nn.LayerNorm(dtype=x.dtype, param_dtype=jnp.float32, name="ln1")
+        ln2 = nn.LayerNorm(dtype=x.dtype, param_dtype=jnp.float32, name="ln2")
+        attn_out, new_cache = Attention(cfg, self.attn_impl)(
+            ln1(x), freqs, cache, pos, deterministic=deterministic)
+        x = x + attn_out
+        if cfg.moe:
+            moe_out, aux_loss = MoE(cfg, name="moe")(
+                ln2(x), deterministic=deterministic)
+            x = x + moe_out
+        else:
+            aux_loss = jnp.float32(0.0)
+            x = x + MLP(cfg, name="mlp")(ln2(x), deterministic=deterministic)
+        return x, new_cache, aux_loss
+
+
+def _sin_table(block_size: int, n_embd: int) -> jnp.ndarray:
+    """Fixed sinusoidal table (reference model.py:544-550)."""
+    position = jnp.arange(block_size, dtype=jnp.float32)[:, None]
+    div_term = jnp.exp(jnp.arange(0, n_embd, 2, dtype=jnp.float32)
+                       * (-math.log(10000.0) / n_embd))
+    angles = position * div_term  # (T, C/2)
+    tab = jnp.zeros((block_size, n_embd), jnp.float32)
+    tab = tab.at[:, 0::2].set(jnp.sin(angles))
+    tab = tab.at[:, 1::2].set(jnp.cos(angles))
+    return tab
+
+
+class LLM(nn.Module):
+    """The full model (reference model.py:535-747)."""
+
+    config: LLMConfig
+    compute_dtype: Any = jnp.float32
+    attn_impl: str = "auto"
+
+    @nn.compact
+    def __call__(self, idx, targets=None, caches=None, pos=0, *,
+                 deterministic: bool = True):
+        cfg = self.config
+        B, T = idx.shape
+        dt = self.compute_dtype
+
+        tkn_emb = nn.Embed(cfg.vocab_size, cfg.n_embd,
+                           embedding_init=_EMBED_INIT,
+                           param_dtype=jnp.float32, dtype=dt, name="tkn_emb")
+        x = tkn_emb(idx)
+        freqs = None
+
+        if cfg.pos_emb == "rope":
+            d = cfg.rope_head_dim if cfg.attn == "mla" else cfg.head_size
+            # constant under jit; XLA folds it (reference precomputes a
+            # complex buffer, model.py:567-577)
+            freqs = precompute_rope_freqs(d, cfg.block_size)
+        elif cfg.pos_emb == "learn":
+            pos_tab = self.param("pos_emb", _EMBED_INIT,
+                                 (cfg.block_size, cfg.n_embd), jnp.float32)
+            p = slice_rows(pos_tab, pos, T)
+            x = x + p.astype(dt)[None]
+        elif cfg.pos_emb == "sin":
+            tab = _sin_table(cfg.block_size, cfg.n_embd)
+            p = slice_rows(tab, pos, T)
+            x = x + p.astype(dt)[None]
+
+        x = nn.Dropout(cfg.dropout, deterministic=deterministic)(x)
+
+        if caches is None:
+            caches = [None] * cfg.n_layer
+
+        block_cls = Block
+        if cfg.act_recomp:
+            # Whole-block rematerialization (reference model.py:677-680).
+            block_cls = nn.remat(Block, prevent_cse=False)
+
+        new_caches = []
+        total_aux = jnp.float32(0.0)
+        for i in range(cfg.n_layer):
+            blk = block_cls(cfg, self.attn_impl, deterministic,
+                            name=f"block_{i}")
+            x, new_cache, aux = blk(x, freqs, caches[i], pos)
+            new_caches.append(new_cache)
+            total_aux = total_aux + aux
+
+        x = nn.LayerNorm(dtype=dt, param_dtype=jnp.float32, name="ln_f")(x)
+
+        if targets is not None:
+            logits = tkn_emb.attend(x)  # weight tying (reference :559-560)
+            # CE with ignore_index=-1 (reference :689), computed in fp32.
+            logits_f = logits.astype(jnp.float32)
+            mask = (targets != -1)
+            safe_targets = jnp.where(mask, targets, 0)
+            logp = jax.nn.log_softmax(logits_f, axis=-1)
+            nll = -jnp.take_along_axis(logp, safe_targets[..., None],
+                                       axis=-1)[..., 0]
+            denom = jnp.maximum(mask.sum(), 1)
+            main_loss = jnp.where(mask, nll, 0.0).sum() / denom
+            loss = main_loss + total_aux / cfg.n_layer
+        else:
+            logits = tkn_emb.attend(x[:, -1:, :])  # last position only (:694)
+            loss = None
+
+        return logits, loss, new_caches
+
+
+def init_cache(config: LLMConfig, batch_size: int,
+               max_len: Optional[int] = None, dtype=jnp.float32):
+    """Create the per-layer static KV-cache pytree for decoding.
+
+    `dtype` should match the model's compute_dtype (fp32 default mirrors
+    LLM's; pass bfloat16 for bf16 inference). Decoding past `max_len` is
+    the caller's responsibility to prevent (XLA clamps out-of-range
+    dynamic_update_slice starts rather than erroring) — `generate` trims
+    with a sliding window before that point, like reference model.py:711-730.
+    """
+    max_len = max_len or config.block_size
+    return [init_attn_cache(config, batch_size, max_len, dtype)
+            for _ in range(config.n_layer)]
+
+
+def count_params(params, config: LLMConfig) -> tuple[int, int]:
+    """(total, active) parameter counts (reference get_num_params,
+    model.py:588-617): active counts shared experts + n_act_routed routed
+    experts per MoE block, everything else fully."""
+    sizes = jax.tree_util.tree_map(lambda x: int(x.size), params)
+    flat = jax.tree_util.tree_flatten_with_path(sizes)[0]
+    total = sum(v for _, v in flat)
+    if not config.moe:
+        return total, total
+    inactive = 0
+    n_routed, k = config.n_routed, config.n_act_routed
+    for path, size in flat:
+        keys = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+        if any(k_ in ("experts_fc", "experts_proj") for k_ in keys):
+            per_expert = size // config.n_exp
+            inactive += per_expert * (n_routed - k)
+    return total, total - inactive

@@ -1,0 +1,10 @@
+"""Hot-path numerical ops: RoPE, fused-attention dispatch (XLA / Pallas /
+ring), and MoE token dispatch. These are the TPU-native stand-ins for the
+reference's delegated CUDA kernels (F.scaled_dot_product_attention, fused
+AdamW, NCCL collectives — see SURVEY.md §2 native-code note)."""
+
+from distributed_pytorch_tpu.ops.rope import (  # noqa: F401
+    precompute_rope_freqs,
+    apply_rotary_emb,
+)
+from distributed_pytorch_tpu.ops.attention_core import sdpa  # noqa: F401

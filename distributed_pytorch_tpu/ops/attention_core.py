@@ -1,0 +1,113 @@
+"""Fused scaled-dot-product attention dispatch.
+
+This is the framework's named equivalent of the reference's delegated
+`F.scaled_dot_product_attention` CUDA kernel (reference
+single-gpu/model.py:149). Implementations:
+
+* 'xla'    — `jax.nn.dot_product_attention`: XLA fuses QK^T+softmax+PV and
+             tiles onto the MXU; supports GQA (n_kv_heads dividing n_head)
+             without materializing repeated KV.
+* 'pallas' — hand-written TPU flash-attention kernel (ops/flash_attention.py),
+             blockwise online softmax in VMEM.
+* 'naive'  — explicit einsum path; supports attention-weight dropout, KV-cache
+             offset masks, and arbitrary masks. Used for decode steps and as
+             the reference semantics oracle in tests.
+* 'auto'   — pallas on TPU when shapes allow, else xla; naive when
+             dropout>0 (the fused paths have no weight-dropout, matching
+             the situation on CUDA where SDPA dropout exists — divergence
+             documented; default configs use dropout=0.0).
+
+Layout convention: q (B, T, nh, hs); k, v (B, S, n_kv, hs) — "BTNH", the
+layout jax.nn.dot_product_attention and the Pallas kernel both want, avoiding
+the reference's transpose dance to (B, nh, T, hs).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover
+        return False
+
+
+def _naive_sdpa(q, k, v, *, scale, q_offset, dropout_rate=0.0,
+                dropout_rng=None, causal=True):
+    """Reference-semantics einsum attention with cache-offset causal mask.
+
+    Mask matches reference model.py:225-226: query global position =
+    q_offset + i may attend key positions j <= q_offset + i.
+    """
+    B, T, nh, hs = q.shape
+    S, nkv = k.shape[1], k.shape[2]
+    if nkv != nh:
+        rep = nh // nkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    attn = jnp.einsum("btnh,bsnh->bnts", qf, kf) * scale
+    if causal:
+        qpos = q_offset + jnp.arange(T)[:, None]
+        kpos = jnp.arange(S)[None, :]
+        mask = qpos >= kpos  # (T, S)
+        attn = jnp.where(mask[None, None], attn, -jnp.inf)
+    attn = jax.nn.softmax(attn, axis=-1)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, attn.shape)
+        attn = jnp.where(keep, attn / (1.0 - dropout_rate), 0.0)
+    out = jnp.einsum("bnts,bsnh->btnh", attn.astype(v.dtype), v)
+    return out
+
+
+def sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+         causal: bool = True,
+         scale: Optional[float] = None,
+         q_offset: int | jnp.ndarray = 0,
+         dropout_rate: float = 0.0,
+         dropout_rng=None,
+         impl: str = "auto") -> jnp.ndarray:
+    """Scaled dot-product attention over (B, T, N, H)-layout tensors.
+
+    `q_offset` is the global position of q[:, 0] (nonzero during KV-cached
+    decode, cf. reference start_pos plumbing at model.py:641-650).
+    """
+    hs = q.shape[-1]
+    scale = (1.0 / hs ** 0.5) if scale is None else scale
+
+    if impl not in ("auto", "pallas", "xla", "naive"):
+        raise ValueError(f"unknown attention impl {impl!r}; expected "
+                         "'auto' | 'pallas' | 'xla' | 'naive'")
+
+    use_dropout = dropout_rate > 0.0 and dropout_rng is not None
+    if use_dropout:
+        # only the naive path implements attention-weight dropout; honoring
+        # the caller's dropout beats honoring their impl choice
+        impl = "naive"
+    elif impl == "auto":
+        impl = "pallas" if _on_tpu() else "xla"
+
+    if impl == "pallas":
+        from distributed_pytorch_tpu.ops.flash_attention import flash_attention_usable, flash_attention
+        if flash_attention_usable(q, k, v, causal=causal):
+            return flash_attention(q, k, v, scale=scale, causal=causal,
+                                   q_offset=q_offset)
+        impl = "xla"
+
+    if impl == "xla":
+        is_static_zero_offset = isinstance(q_offset, int) and q_offset == 0
+        if is_static_zero_offset:
+            return jax.nn.dot_product_attention(
+                q, k, v, scale=scale, is_causal=causal, implementation="xla")
+        impl = "naive"  # offset masks -> explicit path
+
+    return _naive_sdpa(q, k, v, scale=scale, q_offset=q_offset,
+                       dropout_rate=dropout_rate, dropout_rng=dropout_rng,
+                       causal=causal)

@@ -1,0 +1,20 @@
+"""Pallas TPU flash-attention kernel (blockwise online softmax in VMEM).
+
+Stub for now: `flash_attention_usable` returns False so the dispatcher in
+ops/attention_core.py falls through to the XLA fused path. The real kernel
+lands with the Pallas milestone; the interface is fixed here so callers
+don't change.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def flash_attention_usable(q, k, v, *, causal: bool = True) -> bool:
+    return False
+
+
+def flash_attention(q, k, v, *, scale: float, causal: bool = True,
+                    q_offset=0) -> jnp.ndarray:
+    raise NotImplementedError("Pallas flash attention not yet implemented")

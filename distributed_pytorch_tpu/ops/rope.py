@@ -1,0 +1,59 @@
+"""Rotary position embeddings (RoPE).
+
+Reference parity: `LLMconfig.apply_rotary_emb` + `LLM._precompute_freqs_cis`
+(reference single-gpu/model.py:77-96,567-577): theta base 10000, pairs taken
+*adjacently* along the head dim (x reshaped to (..., hs//2, 2)), rotation by
+complex multiply.
+
+TPU-first divergence: no complex dtypes. XLA on TPU lowers complex arithmetic
+to pairs of real ops anyway, and Pallas kernels can't consume complex inputs;
+we precompute real (cos, sin) tables and rotate with two fused multiplies.
+Numerics are identical (same pairing, same angles).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def precompute_rope_freqs(dim: int, max_seq_len: int, base: float = 10000.0,
+                          dtype=jnp.float32) -> jnp.ndarray:
+    """Return a (max_seq_len, dim//2, 2) table of (cos, sin) angles.
+
+    Matches reference _precompute_freqs_cis (model.py:567-577):
+    theta_i = base^(-2i/dim), angle[t, i] = t * theta_i.
+    """
+    assert dim % 2 == 0, "head dimension must be even"
+    theta = 1.0 / (base ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    seq = jnp.arange(max_seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(seq, theta)  # (T, dim//2)
+    return jnp.stack([jnp.cos(freqs), jnp.sin(freqs)], axis=-1).astype(dtype)
+
+
+def slice_rows(table: jnp.ndarray, pos, length: int) -> jnp.ndarray:
+    """table[pos : pos+length] along axis 0, supporting traced `pos` (KV-cached
+    decode) as well as the static pos==0 fast path. Shared by RoPE freq /
+    positional-embedding lookups."""
+    import jax
+    if isinstance(pos, int) and pos == 0:
+        return table[:length]
+    return jax.lax.dynamic_slice_in_dim(table, pos, length, axis=0)
+
+
+def apply_rotary_emb(x: jnp.ndarray, freqs: jnp.ndarray) -> jnp.ndarray:
+    """Rotate pairs (x[..., 2i], x[..., 2i+1]) by the angles in `freqs`.
+
+    x: (B, T, H, hs); freqs: (T, hs//2, 2) slice of the precomputed table
+    (caller slices [start_pos : start_pos+T] for KV-cached decoding, like
+    reference model.py:660). Computation in fp32, cast back to x.dtype
+    (matching reference `x.float()` ... `type_as(x)`).
+    """
+    B, T, H, hs = x.shape
+    xf = x.astype(jnp.float32).reshape(B, T, H, hs // 2, 2)
+    x_re, x_im = xf[..., 0], xf[..., 1]
+    cos = freqs[None, :, None, :, 0]  # (1, T, 1, hs//2)
+    sin = freqs[None, :, None, :, 1]
+    out_re = x_re * cos - x_im * sin
+    out_im = x_re * sin + x_im * cos
+    out = jnp.stack([out_re, out_im], axis=-1).reshape(B, T, H, hs)
+    return out.astype(x.dtype)

@@ -1,0 +1,20 @@
+"""Test harness: force an 8-device CPU platform so every parallelism recipe
+is exercised with real XLA collectives and no TPU (SURVEY.md §4 — the
+reference has zero tests; this virtual mesh replaces its manual 2-GPU
+Kaggle smoke runs).
+
+Note: env vars alone are NOT enough here — the image's sitecustomize
+imports jax at interpreter start (TPU tunnel registration), so JAX's config
+is already initialized by the time conftest runs. `jax.config.update`
+before first backend use still works because backend clients are created
+lazily."""
+
+import os
+
+# Best-effort for subprocesses spawned by tests.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

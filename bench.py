@@ -1,0 +1,69 @@
+"""Headline benchmark: flagship GPT (124M-class) training throughput on the
+available hardware. Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference publishes no numbers (BASELINE.md) — the driver-set north
+star is >=50% MFU on the FSDP config (BASELINE.json), so `vs_baseline` is
+measured MFU / 0.50 (1.0 == target met). On hardware without a known peak
+FLOPs figure (CPU smoke runs), falls back to tokens/sec with
+vs_baseline=0.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main() -> None:
+    import jax
+
+    from distributed_pytorch_tpu.config import LLMConfig, TrainConfig
+    from distributed_pytorch_tpu.train import metrics as M
+    from distributed_pytorch_tpu.train.loop import train
+
+    on_tpu = jax.default_backend() == "tpu"
+    n_dev = len(jax.devices())
+
+    if on_tpu:
+        model_cfg = LLMConfig(
+            vocab_size=50304, block_size=1024, n_embd=768, n_head=12,
+            n_kv_heads=12, attn="mha", n_layer=12, up_dim=3072,
+            non_linearity="swiglu", pos_emb="rope")
+        batch, iters = 8, 12
+    else:  # CPU smoke: tiny proxy so the harness still gets a line
+        model_cfg = LLMConfig(
+            vocab_size=1024, block_size=256, n_embd=256, n_head=8,
+            n_kv_heads=8, attn="mha", n_layer=4, up_dim=1024,
+            non_linearity="swiglu", pos_emb="rope")
+        batch, iters = 4, 6
+
+    recipe = "fsdp" if n_dev > 1 else "single"
+    train_cfg = TrainConfig(
+        dataset="synthetic", data_dir="bench_data",
+        total_batch_size=batch * model_cfg.block_size,
+        batch_size=max(1, batch // n_dev),
+        max_iters=iters, parallelism=recipe,
+        log_interval=10 ** 9, compute_dtype="bfloat16")
+
+    stats = train(model_cfg, train_cfg, log=lambda s: print(s, file=sys.stderr))
+
+    tps_chip = stats["median_tokens_per_sec"] / n_dev
+    mfu = stats.get("median_mfu")
+    if mfu is not None:
+        out = {"metric": "mfu_gpt124m", "value": round(mfu, 4),
+               "unit": "fraction_of_peak",
+               "vs_baseline": round(mfu / 0.50, 4),
+               "tokens_per_sec_per_chip": round(tps_chip, 1),
+               "n_chips": n_dev, "recipe": recipe,
+               "device": jax.devices()[0].device_kind}
+    else:
+        out = {"metric": "tokens_per_sec_per_chip", "value": round(tps_chip, 1),
+               "unit": "tok/s/chip", "vs_baseline": 0,
+               "n_chips": n_dev, "recipe": recipe,
+               "device": jax.devices()[0].device_kind}
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,23 @@
+"""CLI entry point: `python -m distributed_pytorch_tpu --flags...`
+
+Replaces all five reference trainer invocations (single-gpu/train.py,
+torchrun'd multi-gpu/ddp/train.py, and the three kaggle scripts): the
+parallelism strategy is `--parallelism {single,dp,zero1,zero2,fsdp,tp,
+fsdp_tp,ep,sp}` instead of a choice of script, and there is no torchrun —
+on a TPU pod every host runs this same command (see scripts/train.sh).
+Flag surface mirrors the reference's ~33 argparse flags
+(single-gpu/train.py:136-181), including --total_batch_size_str "2**14".
+"""
+
+from distributed_pytorch_tpu.config import build_parser, configs_from_args
+from distributed_pytorch_tpu.train.loop import train
+
+
+def main(argv=None) -> None:
+    args = build_parser().parse_args(argv)
+    model_cfg, train_cfg = configs_from_args(args)
+    train(model_cfg, train_cfg)
+
+
+if __name__ == "__main__":
+    main()

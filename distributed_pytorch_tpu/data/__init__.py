@@ -1,0 +1,6 @@
+"""Data pipeline: offline prepare scripts producing uint16 GPT-2-BPE `.bin`
+shards (format-compatible with the reference's data/*/prepare.py) + a
+memmap-backed random-sampling loader that places batches directly into
+their mesh shards."""
+
+from distributed_pytorch_tpu.data.loader import DataLoader, make_synthetic_bin  # noqa: F401

@@ -1,0 +1,106 @@
+"""Memmap token loader.
+
+Reference parity (`DataLoader`, single-gpu/train.py:210-254): np.memmap of a
+raw uint16 token file; every batch = B *uniform-random* start offsets (not
+sequential epochs); y is x shifted by one. The reference decorrelates DDP
+ranks purely via a +rank seed offset (multi-gpu/ddp/train.py:28-29); here
+every process samples from one counter-based RNG stream keyed by
+(seed, step, accum-slot, row) so the global batch is identical regardless of
+process count — resharding-stable and resumable (a capability the reference
+lacks: its loader state is unrecoverable RNG).
+
+TPU-first: the loader returns the whole optimizer-step batch (accum, B, T)
+and places it into its mesh shards in one `device_put` — per-host, each
+process materializes only its addressable slice (multi-host path via
+`jax.make_array_from_process_local_data`).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding
+
+
+def make_synthetic_bin(path: str, n_tokens: int = 2 ** 20,
+                       vocab_size: int = 50304, seed: int = 1729) -> str:
+    """Write a synthetic uint16 token file with mild Markov structure (so
+    loss can actually decrease — pure uniform noise has nothing to learn).
+    Used by tests and by bench.py when no prepared dataset exists (this
+    environment has no network egress for the real downloads)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    rng = np.random.default_rng(seed)
+    eff_vocab = min(vocab_size, 1024)
+    # tokens follow a noisy ramp: next ~ prev + small step (mod eff_vocab),
+    # with 5% uniform-noise resets
+    walk = np.cumsum(rng.integers(-3, 4, size=n_tokens)) % eff_vocab
+    noise = rng.integers(0, eff_vocab, size=n_tokens)
+    toks = np.where(rng.random(n_tokens) < 0.05, noise, walk)
+    toks.astype(np.uint16).tofile(path)
+    return path
+
+
+class DataLoader:
+    """Random-offset batch sampler over a uint16 token memmap."""
+
+    def __init__(self, file_path: str, batch_size: int, block_size: int, *,
+                 grad_accum: int = 1, seed: int = 1729,
+                 mesh=None, pspec=None):
+        self.tokens = np.memmap(file_path, dtype=np.uint16, mode="r")
+        assert len(self.tokens) > block_size + 1, (
+            f"dataset {file_path} too small: {len(self.tokens)} tokens "
+            f"<= block_size+1")  # reference train.py:221-222
+        self.B, self.T, self.A = batch_size, block_size, grad_accum
+        self.seed = seed
+        self.step = 0
+        self.mesh = mesh
+        self.pspec = pspec
+        self._sharding = (NamedSharding(mesh, pspec)
+                         if mesh is not None and pspec is not None else None)
+
+    def _sample(self, step: int, rows: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Gather (len(rows), T) x/y pairs for global batch-row ids `rows` at
+        `step`. Counter-based (Philox) keyed on (seed, step): any process can
+        materialize any subset of the global batch deterministically."""
+        rng = np.random.Generator(np.random.Philox(key=self.seed + (step << 20)))
+        hi = len(self.tokens) - self.T - 1
+        offsets = rng.integers(0, hi, size=self.A * self.B)[rows]
+        idx = offsets[:, None] + np.arange(self.T + 1)[None, :]
+        seqs = self.tokens[idx].astype(np.int32)
+        return seqs[:, :-1], seqs[:, 1:]
+
+    def next_batch(self, step: Optional[int] = None):
+        """Return (x, y), each (A, B, T) int32, sharded onto the mesh."""
+        step = self.step if step is None else step
+        self.step = step + 1
+
+        if self._sharding is None:
+            rows = np.arange(self.A * self.B)
+            x, y = self._sample(step, rows)
+            shp = (self.A, self.B, self.T)
+            return x.reshape(shp), y.reshape(shp)
+
+        # Sharded: materialize each addressable shard directly from the
+        # memmap — on multi-host, a process never touches rows it doesn't
+        # own; on one process this is just a sharded device_put.
+        sh = self._sharding
+        global_shape = (self.A, self.B, self.T)
+
+        def shard(index, which: int):
+            a_sl, b_sl, t_sl = index
+            accums = np.arange(self.A)[a_sl]
+            rows = np.arange(self.B)[b_sl]
+            grid = (accums[:, None] * self.B + rows[None, :]).reshape(-1)
+            x, y = self._sample(step, grid)
+            shp = (len(accums), len(rows), self.T)
+            out = (x, y)[which].reshape(shp)
+            return out[..., t_sl]
+
+        xs = jax.make_array_from_callback(global_shape, sh,
+                                          lambda i: shard(i, 0))
+        ys = jax.make_array_from_callback(global_shape, sh,
+                                          lambda i: shard(i, 1))
+        return xs, ys

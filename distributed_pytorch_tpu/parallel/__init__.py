@@ -1,0 +1,15 @@
+"""Parallelism recipes: the reference's five trainer entry points
+(single-gpu, DDP, ZeRO-1, ZeRO-2, FSDP — reference single-gpu/train.py,
+multi-gpu/ddp/train.py, kaggle-zero1.py, kaggle-zero2.py, kaggle-fsdp.py)
+plus the strategies its README names but never builds (TP, EP, SP;
+reference README.md:7), each realized as a *named sharding recipe*: a
+PartitionSpec table over a `jax.sharding.Mesh` instead of a separate
+trainer script (SURVEY.md §7 design stance)."""
+
+from distributed_pytorch_tpu.parallel.mesh import MeshPlan, build_mesh  # noqa: F401
+from distributed_pytorch_tpu.parallel.sharding import (  # noqa: F401
+    Recipe,
+    batch_pspec,
+    params_pspecs,
+    shard_like_params,
+)

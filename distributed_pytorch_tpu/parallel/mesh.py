@@ -1,0 +1,98 @@
+"""Device-mesh construction.
+
+The reference's topology layer is torchrun env vars + NCCL process groups
+(multi-gpu/ddp/train.py:19-25); here topology is a `jax.sharding.Mesh` with
+four named axes:
+
+* 'data'   — batch (DP) and, for the ZeRO/FSDP recipes, parameter /
+             optimizer-state sharding (ZeRO shards *state* over the same
+             ranks that replicate compute — one axis, two roles).
+* 'model'  — tensor parallelism (attention heads / MLP up dim), rides ICI.
+* 'expert' — MoE expert parallelism.
+* 'seq'    — sequence/context parallelism (ring attention).
+
+All four axes always exist (size 1 when unused): recipes differ only in
+axis *sizes* and in which PartitionSpecs mention them, so every recipe
+shares one jit cache key structure and one train_step.
+
+Multi-host: `jax.devices()` already spans all hosts once
+`jax.distributed.initialize()` has run (see train/loop.py); mesh axes are
+laid out so 'data' is outermost — DCN-friendly — and 'model'/'seq' innermost
+over ICI, following the scaling-book recipe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("data", "seq", "expert", "model")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshPlan:
+    """Resolved axis sizes for a recipe on a concrete device count."""
+
+    data: int = 1
+    seq: int = 1
+    expert: int = 1
+    model: int = 1
+
+    @property
+    def n_devices(self) -> int:
+        return self.data * self.seq * self.expert * self.model
+
+    def axis_sizes(self) -> tuple[int, int, int, int]:
+        return (self.data, self.seq, self.expert, self.model)
+
+
+def resolve_plan(recipe: str, n_devices: int, *, tp_size: int = 1,
+                 ep_size: int = 1, sp_size: int = 1,
+                 dp_size: int = -1) -> MeshPlan:
+    """Compute axis sizes for `recipe` over `n_devices`.
+
+    The reference derives world topology implicitly from torchrun
+    (`WORLD_SIZE`, ddp/train.py:20-22); here the recipe name declares which
+    axes are live and remaining devices land on 'data'.
+    """
+    tp = tp_size if recipe in ("tp", "fsdp_tp") else 1
+    ep = ep_size if recipe == "ep" else 1
+    sp = sp_size if recipe == "sp" else 1
+    if recipe == "single":
+        return MeshPlan(1, 1, 1, 1)
+    denom = tp * ep * sp
+    assert n_devices % denom == 0, (
+        f"recipe {recipe!r} needs tp*ep*sp={denom} dividing device count "
+        f"{n_devices}")
+    dp = n_devices // denom if dp_size == -1 else dp_size
+    assert dp * denom == n_devices, (
+        f"dp_size {dp} * tp*ep*sp {denom} != {n_devices} devices")
+    return MeshPlan(data=dp, seq=sp, expert=ep, model=tp)
+
+
+def build_mesh(plan: MeshPlan,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """Build the 4-axis mesh. Axis order (data, seq, expert, model) puts
+    'model' fastest-varying: adjacent devices (ICI neighbors on TPU) serve
+    the bandwidth-hungriest collectives, 'data' the outermost (DCN-capable)
+    ones."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = plan.n_devices
+    assert len(devices) >= n, f"need {n} devices, have {len(devices)}"
+    arr = np.array(devices[:n]).reshape(plan.axis_sizes())
+    return Mesh(arr, AXES)
+
+
+def mesh_for(recipe: str, *, tp_size: int = 1, ep_size: int = 1,
+             sp_size: int = 1, dp_size: int = -1,
+             devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """One-call convenience: resolve + build for the current device set."""
+    devs = list(devices if devices is not None else jax.devices())
+    n = 1 if recipe == "single" else len(devs)
+    plan = resolve_plan(recipe, n, tp_size=tp_size, ep_size=ep_size,
+                        sp_size=sp_size, dp_size=dp_size)
+    return build_mesh(plan, devs)

@@ -1,0 +1,12 @@
+"""Trainer: ONE jit-compiled training step + loop serving every parallelism
+recipe (the reference maintains five near-identical trainer scripts; see
+SURVEY.md §7 design stance)."""
+
+from distributed_pytorch_tpu.train.state import (  # noqa: F401
+    TrainState,
+    create_train_state,
+    lr_schedule,
+    make_optimizer,
+)
+from distributed_pytorch_tpu.train.step import make_train_step, make_eval_step  # noqa: F401
+from distributed_pytorch_tpu.train.loop import train  # noqa: F401

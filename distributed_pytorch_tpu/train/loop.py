@@ -1,0 +1,204 @@
+"""The training loop: the runtime that replaces all five reference trainer
+scripts (single-gpu/train.py:312-359, multi-gpu/ddp/train.py:291-337, and
+the three kaggle variants' `:1068-1139` loops).
+
+Per optimizer step: ONE jitted call executes the whole micro-batch
+grad-accumulation scan, clip, and AdamW update (the reference runs a Python
+micro-step loop with autocast/scaler bookkeeping); the host meanwhile
+prefetches the next batch from the memmap (reference train.py:343 prefetch).
+Logging: loss, dt, tokens/sec/chip and MFU (BASELINE.json metrics; the
+reference logs only ms/step + reserved GB, train.py:354-359).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+from distributed_pytorch_tpu.config import LLMConfig, TrainConfig
+from distributed_pytorch_tpu.data.loader import DataLoader, make_synthetic_bin
+from distributed_pytorch_tpu.models.gpt import count_params
+from distributed_pytorch_tpu.parallel import sharding as shd
+from distributed_pytorch_tpu.parallel.mesh import mesh_for
+from distributed_pytorch_tpu.train import checkpoint as ckpt
+from distributed_pytorch_tpu.train import metrics as M
+from distributed_pytorch_tpu.train.state import create_train_state
+from distributed_pytorch_tpu.train.step import make_eval_step, make_train_step
+
+
+def maybe_initialize_distributed() -> None:
+    """Multi-host bring-up (SURVEY.md §2c multi-node gap): the reference is
+    single-node only (`torchrun --standalone`, multi-gpu/ddp/train.sh:49).
+    On TPU pods, launchers set JAX_COORDINATOR_ADDRESS etc.; initialize
+    exactly once, and only when a multi-process env is announced."""
+    if jax.process_count() > 1:
+        return  # already initialized
+    if os.environ.get("JAX_COORDINATOR_ADDRESS") or \
+            os.environ.get("JAX_NUM_PROCESSES"):
+        try:
+            jax.distributed.initialize()
+        except Exception as e:  # pragma: no cover
+            print(f"[dist] initialize skipped: {e}")
+
+
+def _data_paths(train_cfg: TrainConfig, vocab_size: int) -> tuple[str, str]:
+    d = os.path.join(train_cfg.data_dir, train_cfg.dataset)
+    train_bin = os.path.join(d, "train.bin")
+    val_bin = os.path.join(d, "val.bin")
+    if not os.path.exists(train_bin):
+        if train_cfg.dataset == "synthetic":
+            make_synthetic_bin(train_bin, n_tokens=2 ** 21,
+                               vocab_size=vocab_size)
+            make_synthetic_bin(val_bin, n_tokens=2 ** 17, seed=271828,
+                               vocab_size=vocab_size)
+        else:
+            raise FileNotFoundError(
+                f"{train_bin} not found — run "
+                f"python -m distributed_pytorch_tpu.data.prepare_"
+                f"{train_cfg.dataset} (or use --dataset synthetic)")
+    return train_bin, val_bin
+
+
+def estimate_loss(eval_step, state, loaders: dict, eval_iters: int) -> dict:
+    """Mean eval loss over eval_iters random batches per split (reference
+    estimate_loss, single-gpu/train.py:280-293)."""
+    out = {}
+    for split, loader in loaders.items():
+        losses = []
+        for k in range(eval_iters):
+            x, y = loader.next_batch()
+            # eval consumes single micro-batches: take accum slot 0
+            losses.append(eval_step(state, x[0], y[0]))
+        out[split] = float(np.mean(jax.device_get(losses)))
+    return out
+
+
+def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
+          log: Callable[[str], None] = print) -> dict[str, Any]:
+    """Run the full training job; returns a stats dict (loss curves,
+    throughput) — the in-memory equivalent of the reference's
+    `<name>_stats.pt` (single-gpu/train.py:363-372)."""
+    maybe_initialize_distributed()
+    is_main = jax.process_index() == 0
+    say = (lambda s: log(s)) if is_main else (lambda s: None)
+
+    mesh = mesh_for(train_cfg.parallelism, tp_size=train_cfg.tp_size,
+                    ep_size=train_cfg.ep_size, sp_size=train_cfg.sp_size,
+                    dp_size=train_cfg.dp_size)
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    n_chips = int(np.prod(mesh.devices.shape))
+    say(f"mesh {sizes} over {n_chips} {jax.devices()[0].device_kind} "
+        f"device(s); recipe={train_cfg.parallelism}")
+
+    # ---- grad accumulation arithmetic (reference train.py:297-301) -------
+    B, T = train_cfg.batch_size, model_cfg.block_size
+    b_glob = B * sizes["data"]
+    assert train_cfg.total_batch_size % (b_glob * T) == 0, (
+        f"total_batch_size {train_cfg.total_batch_size} not divisible by "
+        f"B*T*dp = {b_glob * T}")
+    grad_accum = train_cfg.total_batch_size // (b_glob * T)
+    tokens_per_step = train_cfg.total_batch_size
+    say(f"grad_accum={grad_accum} micro-steps of {b_glob}x{T} tokens "
+        f"-> {tokens_per_step} tokens/step")
+
+    # ---- data ------------------------------------------------------------
+    train_bin, val_bin = _data_paths(train_cfg, model_cfg.vocab_size)
+    bspec = shd.batch_pspec(train_cfg.parallelism, mesh, leading_accum=True)
+    mk = lambda p, seed: DataLoader(p, b_glob, T, grad_accum=grad_accum,
+                                    seed=seed, mesh=mesh, pspec=bspec)
+    train_loader = mk(train_bin, train_cfg.seed)
+    val_loader = mk(val_bin, train_cfg.seed + 1)
+
+    # ---- model / state / steps ------------------------------------------
+    model, tx, state, state_sharding = create_train_state(
+        model_cfg, train_cfg, mesh)
+    total, active = count_params(state.params, model_cfg)
+    say(f"params: {total / 1e6:.2f}M total, {active / 1e6:.2f}M active")
+
+    start_step = 0
+    ckpt_root = os.path.join("checkpoints", train_cfg.file_name)
+    if train_cfg.resume:
+        last = ckpt.latest_step_dir(ckpt_root)
+        if last is not None:
+            abstract = jax.tree_util.tree_map(
+                lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), state)
+            state = ckpt.restore_checkpoint(last, abstract, state_sharding)
+            start_step = int(jax.device_get(state.step))
+            say(f"resumed from {last} at step {start_step}")
+
+    train_step = make_train_step(model, tx, model_cfg, train_cfg, mesh,
+                                 state_sharding)
+    eval_step = make_eval_step(model, train_cfg, mesh, state_sharding)
+
+    # ---- loop ------------------------------------------------------------
+    stats = {"train_losses": [], "val_losses": [], "step_times": [],
+             "tokens_per_sec": [], "mfu": []}
+    flops_per_step = M.step_flops(model_cfg, tokens_per_step, T)
+    peak = M.peak_flops_per_chip()
+
+    if train_cfg.profile and is_main:
+        jax.profiler.start_trace("profile_trace")
+
+    x, y = train_loader.next_batch()
+    t_prev = time.perf_counter()
+    for it in range(start_step, train_cfg.max_iters + 1):
+        if train_cfg.eval and it % train_cfg.eval_interval == 0:
+            t0 = time.perf_counter()
+            ev = estimate_loss(eval_step, state,
+                               {"train": train_loader, "val": val_loader},
+                               train_cfg.eval_iters)
+            stats["val_losses"].append((it, ev["val"]))
+            say(f"iter {it}: train {ev['train']:.4f} val {ev['val']:.4f} "
+                f"({time.perf_counter() - t0:.1f}s)")
+
+        state, m = train_step(state, x, y)
+        x, y = train_loader.next_batch()      # host prefetch while device runs
+        m = jax.device_get(m)                 # blocks on step completion
+        t_now = time.perf_counter()
+        dt = t_now - t_prev
+        t_prev = t_now
+
+        loss = float(m["loss"])
+        stats["train_losses"].append(loss)
+        if it > start_step:                   # first step includes compile
+            stats["step_times"].append(dt)
+            tps = tokens_per_step / dt
+            stats["tokens_per_sec"].append(tps)
+            if peak:
+                stats["mfu"].append(flops_per_step / dt / (peak * n_chips))
+        if it % train_cfg.log_interval == 0:
+            tps = tokens_per_step / dt
+            mfu_s = (f" | mfu {flops_per_step / dt / (peak * n_chips):6.2%}"
+                     if peak else "")
+            say(f"iter {it:5d} | loss {loss:.4f} | dt {dt * 1e3:7.1f}ms | "
+                f"tok/s/chip {tps / n_chips:10.0f}{mfu_s}")
+
+        if train_cfg.ckpt_interval and it and it % train_cfg.ckpt_interval == 0:
+            path = ckpt.save_checkpoint(
+                os.path.join(ckpt_root, f"step_{it}"), state,
+                model_cfg, train_cfg)
+            say(f"checkpoint -> {path}")
+
+    if train_cfg.profile and is_main:
+        jax.profiler.stop_trace()
+
+    if train_cfg.save_model:
+        final = int(jax.device_get(state.step))
+        path = ckpt.save_checkpoint(
+            os.path.join(ckpt_root, f"step_{final}"), state,
+            model_cfg, train_cfg)
+        say(f"final checkpoint -> {path}")
+
+    stats["final_loss"] = stats["train_losses"][-1] if stats["train_losses"] else None
+    stats["state"] = state
+    if stats["step_times"]:
+        med = float(np.median(stats["step_times"]))
+        stats["median_step_time"] = med
+        stats["median_tokens_per_sec"] = tokens_per_step / med
+        stats["median_mfu"] = (flops_per_step / med / (peak * n_chips)
+                               if peak else None)
+    return stats

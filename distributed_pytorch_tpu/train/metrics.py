@@ -1,0 +1,89 @@
+"""Throughput + MFU accounting.
+
+The reference instruments only wall-clock ms/step and reserved GPU memory
+(single-gpu/train.py:354-359); BASELINE.json's metrics are tokens/sec/chip
+and MFU, so this framework computes them natively. MFU is measured honestly
+for MoE (only *active* experts count — SURVEY.md §7 hard part (e)) and MLA
+(the latent down/up projections are counted as the matmuls actually run).
+
+Model FLOPs: for every matmul with an (in, out) kernel touched by a token,
+forward costs 2*in*out FLOPs/token; backward 2x forward; activation
+recomputation adds one more forward (factor 4/3). Attention scores+values
+add 4*T*C per token per layer, halved for causality. The weight-tied
+lm_head matmul (vocab_size*n_embd) is counted; the embedding *lookup* is
+not a matmul and is excluded.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from distributed_pytorch_tpu.config import LLMConfig
+
+# Peak dense bf16 TFLOP/s per chip, by `jax.devices()[0].device_kind`
+# substring (public spec-sheet numbers).
+_PEAK_FLOPS = (
+    ("v6", 918e12),        # Trillium
+    ("v5p", 459e12),
+    ("v5", 197e12),        # v5e ("v5 lite")
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def peak_flops_per_chip() -> float | None:
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:  # pragma: no cover
+        return None
+    for key, val in _PEAK_FLOPS:
+        if key in kind:
+            return val
+    return None
+
+
+def matmul_params_per_token(cfg: LLMConfig) -> int:
+    """Active matmul parameters touched per token (MoE: shared + n_act_routed
+    routed experts only; cf. reference get_num_params 'active' count,
+    single-gpu/model.py:588-617)."""
+    C, hs, nh, nkvh = cfg.n_embd, cfg.head_size, cfg.n_head, cfg.n_kv_heads
+
+    if cfg.attn in ("mha", "mqa", "gqa"):
+        attn = C * (C + 2 * nkvh * hs) + C * C          # c_attn + c_proj
+    else:  # mla
+        nlq, nlkv = cfg.q_latent_dim, cfg.kv_latent_dim
+        attn = (C * nlq + nlq * C                        # W_dq, W_uq
+                + C * nlkv + 2 * nlkv * C                # W_dkv, W_uk, W_uv
+                + C * C)                                 # W_o
+        if cfg.pos_emb == "rope":
+            attn += nlq * nh * cfg.rope_head_dim + C * cfg.rope_head_dim
+
+    fc_out = 2 * cfg.up_dim if cfg.non_linearity.lower() in ("swiglu", "glu") \
+        else cfg.up_dim
+    one_mlp = C * fc_out + cfg.up_dim * C
+    if cfg.moe:
+        ffn = one_mlp * (cfg.n_shared + cfg.n_act_routed) \
+            + C * cfg.n_routed                           # router
+    else:
+        ffn = one_mlp
+
+    lm_head = cfg.vocab_size * C                         # weight-tied matmul
+    return cfg.n_layer * (attn + ffn) + lm_head
+
+
+def step_flops(cfg: LLMConfig, tokens_per_step: int, seq_len: int) -> float:
+    """Total train-step FLOPs (fwd + bwd [+ remat fwd])."""
+    per_tok_fwd = 2 * matmul_params_per_token(cfg) \
+        + cfg.n_layer * 2 * cfg.n_embd * seq_len  # causal attn: 4*T*C/2
+    mult = 4 if cfg.act_recomp else 3             # bwd = 2x fwd
+    return mult * per_tok_fwd * tokens_per_step
+
+
+def mfu(cfg: LLMConfig, tokens_per_step: int, seq_len: int,
+        step_time_s: float, n_chips: int) -> float | None:
+    peak = peak_flops_per_chip()
+    if peak is None or step_time_s <= 0:
+        return None
+    achieved = step_flops(cfg, tokens_per_step, seq_len) / step_time_s
+    return achieved / (peak * n_chips)

@@ -1,0 +1,134 @@
+"""Train state + optimizer factory.
+
+Reference parity:
+* LR schedule — `get_lr` (single-gpu/train.py:263-278): linear warmup
+  `max_lr*(i+1)/warmup`, then cosine decay to `0.1*max_lr` over a horizon of
+  `max_iters+2` ("avoid division by zero" in the reference).
+* AdamW with two param groups by `p.dim() >= 2` — weights/embeddings decay,
+  biases/layernorm gains don't (`configure_optimizers`, model.py:619-637);
+  torch AdamW defaults betas=(0.9, 0.999), eps=1e-8. The reference's
+  "fused=True" CUDA fast path needs no analogue: optax's update is a small
+  elementwise pytree program XLA fuses into few kernels — that IS the fused
+  AdamW on TPU (SURVEY.md §2 native-code note).
+* Grad clipping by global norm (train.py:349) lives in the optax chain.
+
+The aux-loss-free MoE bias (`moe_state` collection) is part of the train
+state: it is cross-batch mutable state updated inside the step (reference
+updates it under `torch.no_grad()` in the forward, model.py:466-470).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+
+from distributed_pytorch_tpu.config import LLMConfig, TrainConfig
+from distributed_pytorch_tpu.models.gpt import LLM
+
+
+@flax.struct.dataclass
+class TrainState:
+    step: jnp.ndarray          # int32 scalar
+    params: Any                # fp32 master weights
+    opt_state: Any             # optax state (ZeRO shards this)
+    moe_state: Any             # {'expert_bias': ...} per MoE layer, or {}
+
+
+def lr_schedule(cfg: TrainConfig) -> optax.Schedule:
+    """Pure function of step, exactly the reference's get_lr
+    (single-gpu/train.py:263-278)."""
+    max_lr = cfg.learning_rate
+    min_lr = 0.1 * max_lr
+    warmup = cfg.warmup_steps
+    horizon = cfg.max_iters + 2  # reference: "avoid division by zero"
+
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = max_lr * (step + 1.0) / warmup
+        ratio = jnp.clip((step - warmup) / (horizon - warmup), 0.0, 1.0)
+        coeff = 0.5 * (1.0 + jnp.cos(jnp.pi * ratio))
+        cos = min_lr + coeff * (max_lr - min_lr)
+        return jnp.where(step < warmup, warm, jnp.where(step > horizon,
+                                                        min_lr, cos))
+    return schedule
+
+
+def _decay_mask(params: Any) -> Any:
+    """Reference param grouping: decay iff tensor rank >= 2
+    (model.py:623-626)."""
+    return jax.tree_util.tree_map(lambda p: p.ndim >= 2, params)
+
+
+def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
+    return optax.chain(
+        optax.clip_by_global_norm(cfg.grad_clip),
+        optax.adamw(
+            learning_rate=lr_schedule(cfg),
+            b1=0.9, b2=0.999, eps=1e-8,      # torch AdamW defaults
+            weight_decay=cfg.weight_decay,
+            mask=_decay_mask,
+        ),
+    )
+
+
+def build_model(model_cfg: LLMConfig, train_cfg: TrainConfig) -> LLM:
+    dtype = jnp.dtype(train_cfg.compute_dtype)
+    return LLM(model_cfg, compute_dtype=dtype, attn_impl=train_cfg.attn_impl)
+
+
+def init_train_state(rng: jax.Array, model: LLM, model_cfg: LLMConfig,
+                     tx: optax.GradientTransformation,
+                     batch_size: int = 2) -> TrainState:
+    """Initialize params (+ moe_state) and optimizer state. Runs under
+    jit/eval_shape so it can be staged out with shardings (see
+    create_train_state)."""
+    dummy = jnp.zeros((batch_size, model_cfg.block_size), jnp.int32)
+    variables = model.init({"params": rng, "dropout": rng}, dummy, dummy)
+    params = variables["params"]
+    moe_state = variables.get("moe_state", {})
+    opt_state = tx.init(params)
+    return TrainState(step=jnp.zeros((), jnp.int32), params=params,
+                      opt_state=opt_state, moe_state=moe_state)
+
+
+def create_train_state(model_cfg: LLMConfig, train_cfg: TrainConfig,
+                       mesh=None, rng: Optional[jax.Array] = None):
+    """Build (model, tx, state, state_sharding).
+
+    With a mesh, the state is *initialized directly into its shards* —
+    jit-staged with out_shardings from the recipe tables, so a model larger
+    than one chip's HBM never materializes unsharded (the reference's FSDP
+    equivalent is `sync_module_states=True` broadcast from rank 0,
+    kaggle-fsdp.py:1085 — which does materialize the full model there).
+    """
+    from distributed_pytorch_tpu.parallel import sharding as shd
+
+    model = build_model(model_cfg, train_cfg)
+    tx = make_optimizer(train_cfg)
+    rng = rng if rng is not None else jax.random.PRNGKey(train_cfg.seed)
+
+    def init_fn(r):
+        return init_train_state(r, model, model_cfg, tx,
+                                batch_size=train_cfg.batch_size)
+
+    if mesh is None:
+        return model, tx, jax.jit(init_fn)(rng), None
+
+    recipe = train_cfg.parallelism
+    state_shapes = jax.eval_shape(init_fn, rng)
+    p_specs = shd.params_pspecs(state_shapes.params, recipe, mesh)
+    p_shapes = jax.tree_util.tree_map(lambda l: tuple(l.shape),
+                                      state_shapes.params)
+    opt_specs = shd.shard_like_params(state_shapes.opt_state, p_shapes,
+                                      p_specs, recipe, mesh)
+    moe_specs = jax.tree_util.tree_map(lambda l: shd.P(),
+                                       state_shapes.moe_state)
+    spec_tree = TrainState(step=shd.P(), params=p_specs,
+                           opt_state=opt_specs, moe_state=moe_specs)
+    state_sharding = shd.named(mesh, spec_tree)
+    state = jax.jit(init_fn, out_shardings=state_sharding)(rng)
+    return model, tx, state, state_sharding

@@ -1,0 +1,136 @@
+"""KV-cached autoregressive generation: temperature + top-k sampling.
+
+Reference parity (`LLM.generate`, single-gpu/model.py:700-747):
+* prompt cropped to the last block_size tokens (reference :704-709);
+* per-step: forward the last token only against the KV cache, scale logits
+  by temperature, filter to top-k, sample (reference :733-743);
+* when the cache fills, the reference trims every layer's cache to
+  block_size-1 — a sliding window (reference :711-730).
+
+TPU-first design (SURVEY §7 hard part (c) — static shapes for XLA):
+* caches are fixed (B, S, ...) buffers + an integer position (models/gpt.py
+  `init_cache`); the whole decode loop is ONE `lax.scan` inside ONE jit —
+  no per-token retrace, no concat-and-grow;
+* the sliding window becomes a roll-by-one of the cache buffers under
+  `jnp.where(full, ...)` instead of a Python-side trim, so the compiled
+  step is position-independent;
+* sampling uses a counter-based PRNG key folded per step (reproducible
+  regardless of batch size), `jax.lax.top_k` + mask for the top-k filter,
+  and `jax.random.categorical` for the multinomial draw; temperature == 0.0
+  selects greedy argmax (an extension; the reference divides by zero).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from distributed_pytorch_tpu.models.gpt import init_cache
+
+
+def sample_token(logits: jnp.ndarray, rng, *, temperature: float = 1.0,
+                 top_k: Optional[int] = None) -> jnp.ndarray:
+    """Sample token ids from (B, V) logits (reference model.py:736-743)."""
+    logits = logits.astype(jnp.float32)
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    # top_k in (None, 0) means no truncation (the CLI passes 0 for "off")
+    if top_k is not None and 0 < top_k < logits.shape[-1]:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def _roll_window(caches, pos: jnp.ndarray, max_len: int):
+    """Sliding-window cache: once `pos` hits the buffer end, shift every
+    layer's cache left by one and clamp the write position to the last slot
+    (the static-shape equivalent of the reference's trim-to-block_size-1,
+    model.py:711-730)."""
+    full = pos >= max_len
+
+    def roll(c):
+        return jnp.where(full, jnp.roll(c, -1, axis=1), c)
+
+    caches = jax.tree_util.tree_map(roll, caches)
+    return caches, jnp.minimum(pos, max_len - 1)
+
+
+def make_generate_fn(model, max_new_tokens: int, *, temperature: float = 1.0,
+                     top_k: Optional[int] = None,
+                     max_len: Optional[int] = None, cache_dtype=None):
+    """Build a jitted `generate(variables, prompt, rng) -> (B, T0 + new)`.
+
+    `variables` is the flax variable dict ({'params': ..., ['moe_state': ...]});
+    `prompt` (B, T0) int32, T0 <= block_size (crop host-side first — static
+    shapes). The returned function is traced once per (B, T0) shape.
+    """
+    cfg = model.config
+    max_len = max_len or cfg.block_size
+    cache_dtype = cache_dtype or model.compute_dtype
+
+    if max_new_tokens <= 0:  # reference range(0) no-op, model.py:703
+        return lambda variables, prompt, rng: prompt
+
+    def apply_step(variables, idx, caches, pos):
+        logits, _, caches = model.apply(variables, idx, None, caches, pos,
+                                        deterministic=True)
+        return logits[:, -1, :], caches
+
+    @jax.jit
+    def generate(variables: Any, prompt: jnp.ndarray, rng) -> jnp.ndarray:
+        B, T0 = prompt.shape
+        assert T0 <= max_len, (
+            f"prompt length {T0} exceeds cache size {max_len}; crop to the "
+            f"last block_size tokens first (reference model.py:704-709)")
+        caches = init_cache(cfg, B, max_len, dtype=cache_dtype)
+
+        # Prefill: one full-sequence forward populates every layer's cache.
+        logits, caches = apply_step(variables, prompt, caches, 0)
+        tok = sample_token(logits, jax.random.fold_in(rng, 0),
+                           temperature=temperature, top_k=top_k)
+
+        def step(carry, i):
+            tok, caches, pos = carry
+            caches, pos_eff = _roll_window(caches, pos, max_len)
+            logits, caches = apply_step(variables, tok[:, None], caches,
+                                        pos_eff)
+            nxt = sample_token(logits, jax.random.fold_in(rng, i),
+                               temperature=temperature, top_k=top_k)
+            return (nxt, caches, pos + 1), tok
+
+        (last, _, _), toks = jax.lax.scan(
+            step, (tok, caches, jnp.int32(T0)),
+            jnp.arange(1, max_new_tokens, dtype=jnp.int32))
+        # toks: (max_new_tokens - 1, B) — each step emits its *incoming*
+        # token; the final sampled token closes the sequence.
+        new = jnp.concatenate([toks.T, last[:, None]], axis=1) \
+            if max_new_tokens > 1 else last[:, None]
+        return jnp.concatenate([prompt, new], axis=1)
+
+    return generate
+
+
+def generate(model, variables: Any, prompt, max_new_tokens: int, *,
+             rng=None, temperature: float = 1.0, top_k: Optional[int] = None,
+             max_len: Optional[int] = None) -> jnp.ndarray:
+    """Convenience one-shot wrapper (reference `LLM.generate` call shape).
+
+    Crops the prompt to the last `block_size` tokens host-side, builds the
+    jitted loop, and runs it. For repeated sampling at fixed shapes, build
+    once with `make_generate_fn` and reuse.
+    """
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if prompt.ndim == 1:
+        prompt = prompt[None]
+    cfg = model.config
+    if prompt.shape[1] > cfg.block_size:
+        prompt = prompt[:, -cfg.block_size:]
+    fn = make_generate_fn(model, max_new_tokens, temperature=temperature,
+                          top_k=top_k, max_len=max_len)
+    return fn(variables, prompt, rng)

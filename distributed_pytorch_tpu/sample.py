@@ -1,0 +1,92 @@
+"""Sampling CLI: `python -m distributed_pytorch_tpu.sample --ckpt <dir>`.
+
+The reference ships `LLM.generate` (single-gpu/model.py:700-747) but no
+trainer or script ever calls it (SURVEY.md §3.4 "capability exists only as
+API surface"); this CLI closes that gap: load a checkpoint written by the
+trainer (`--save_model` / `--ckpt_interval`), tokenize a prompt, decode.
+
+Tokenization uses tiktoken's GPT-2 BPE when available (the prepare scripts'
+vocabulary); otherwise the prompt must be comma-separated token ids and
+output is printed as ids.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import jax
+import jax.numpy as jnp
+
+
+def _encoder():
+    try:
+        import tiktoken
+        return tiktoken.get_encoding("gpt2")
+    except Exception:
+        return None
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="Sample from a trained checkpoint")
+    p.add_argument("--ckpt", type=str, required=True,
+                   help="checkpoint dir (checkpoints/<name>/step_N or the "
+                        "<name> root, in which case the newest step is used)")
+    p.add_argument("--prompt", type=str, default="\n",
+                   help="text prompt (or comma-separated token ids when no "
+                        "tokenizer is available)")
+    p.add_argument("--max_new_tokens", type=int, default=200)
+    p.add_argument("--temperature", type=float, default=1.0)
+    p.add_argument("--top_k", type=int, default=50)
+    p.add_argument("--num_samples", type=int, default=1)
+    p.add_argument("--seed", type=int, default=1729)
+    args = p.parse_args(argv)
+
+    from distributed_pytorch_tpu.models.generate import make_generate_fn
+    from distributed_pytorch_tpu.train import checkpoint as ckpt
+    from distributed_pytorch_tpu.train.state import (build_model,
+                                                     init_train_state,
+                                                     make_optimizer)
+
+    path = args.ckpt
+    if not os.path.exists(os.path.join(path, "config.json")):
+        last = ckpt.latest_step_dir(path)
+        assert last is not None, f"no checkpoint found under {path}"
+        path = last
+    model_cfg, train_cfg, step = ckpt.load_configs(path)
+    print(f"loaded config from {path} (step {step}): "
+          f"{model_cfg.n_layer}L/{model_cfg.n_embd}d {model_cfg.attn}")
+
+    # Shapes only (jax.eval_shape): no concrete init of params or AdamW
+    # moments just to learn the checkpoint's structure.
+    model = build_model(model_cfg, train_cfg)
+    tx = make_optimizer(train_cfg)
+    abstract = jax.eval_shape(
+        lambda r: init_train_state(r, model, model_cfg, tx,
+                                   batch_size=train_cfg.batch_size),
+        jax.random.PRNGKey(0))
+    state = ckpt.restore_checkpoint(path, abstract)
+    variables = {"params": state.params}
+    if state.moe_state:
+        variables["moe_state"] = state.moe_state
+
+    enc = _encoder()
+    if enc is not None:
+        ids = enc.encode(args.prompt, allowed_special="all")
+    else:
+        ids = [int(t) for t in args.prompt.split(",") if t.strip()]
+        ids = ids or [0]
+    prompt = jnp.asarray(ids, jnp.int32)[None, -model_cfg.block_size:]
+
+    gen = make_generate_fn(model, args.max_new_tokens,
+                           temperature=args.temperature, top_k=args.top_k)
+    rng = jax.random.PRNGKey(args.seed)
+    for i in range(args.num_samples):
+        out = gen(variables, prompt, jax.random.fold_in(rng, i))
+        toks = jax.device_get(out)[0].tolist()
+        print("-" * 40)
+        print(enc.decode(toks) if enc is not None else toks)
+
+
+if __name__ == "__main__":
+    main()

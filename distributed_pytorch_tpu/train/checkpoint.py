@@ -67,11 +67,14 @@ def restore_checkpoint(path: str, abstract_state: Any,
 
     `abstract_state`: a TrainState of ShapeDtypeStructs (jax.eval_shape of
     the init fn); with `state_sharding`, arrays come back already placed in
-    their mesh shards."""
-    if state_sharding is not None:
-        abstract_state = jax.tree_util.tree_map(
-            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
-            abstract_state, state_sharding)
+    their mesh shards. Without one (single-process inference, e.g. the
+    sampling CLI), everything lands on the default device."""
+    if state_sharding is None:
+        one = jax.sharding.SingleDeviceSharding(jax.local_devices()[0])
+        state_sharding = jax.tree_util.tree_map(lambda s: one, abstract_state)
+    abstract_state = jax.tree_util.tree_map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        abstract_state, state_sharding)
     with ocp.StandardCheckpointer() as ckptr:
         return ckptr.restore(os.path.join(_abs(path), "state"),
                              abstract_state)

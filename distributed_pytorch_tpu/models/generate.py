@@ -70,6 +70,10 @@ def make_generate_fn(model, max_new_tokens: int, *, temperature: float = 1.0,
     """
     cfg = model.config
     max_len = max_len or cfg.block_size
+    assert max_len <= cfg.block_size, (
+        f"max_len {max_len} exceeds block_size {cfg.block_size}: the "
+        f"rope/learned/sin position tables only cover block_size rows "
+        f"(positions beyond would silently clamp)")
     cache_dtype = cache_dtype or model.compute_dtype
 
     if max_new_tokens <= 0:  # reference range(0) no-op, model.py:703

@@ -92,13 +92,17 @@ def sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
         # the caller's dropout beats honoring their impl choice
         impl = "naive"
     elif impl == "auto":
-        impl = "pallas" if _on_tpu() else "xla"
+        # XLA's fused attention is at parity with the Pallas kernel for
+        # short sequences; beyond ~4k keys XLA materializes the O(T*S)
+        # score matrix (OOM by 32k) while the flash kernel stays O(T).
+        long_seq = k.shape[1] > 4096
+        impl = "pallas" if (_on_tpu() and long_seq) else "xla"
 
     if impl == "pallas":
         from distributed_pytorch_tpu.ops.flash_attention import flash_attention_usable, flash_attention
-        if flash_attention_usable(q, k, v, causal=causal):
-            return flash_attention(q, k, v, scale=scale, causal=causal,
-                                   q_offset=q_offset)
+        static_zero = isinstance(q_offset, int) and q_offset == 0
+        if static_zero and flash_attention_usable(q, k, v, causal=causal):
+            return flash_attention(q, k, v, scale=scale, causal=causal)
         impl = "xla"
 
     if impl == "xla":

@@ -1,20 +1,382 @@
-"""Pallas TPU flash-attention kernel (blockwise online softmax in VMEM).
+"""Pallas TPU flash attention: blockwise online-softmax in VMEM, with a
+hand-written FlashAttention-2-style backward (custom VJP).
 
-Stub for now: `flash_attention_usable` returns False so the dispatcher in
-ops/attention_core.py falls through to the XLA fused path. The real kernel
-lands with the Pallas milestone; the interface is fixed here so callers
-don't change.
+This is the framework's native-kernel replacement for the fused attention
+the reference delegates to `F.scaled_dot_product_attention` (reference
+single-gpu/model.py:149). Design (per the Pallas TPU playbook):
+
+* Grid (B, H, q_blocks, kv_blocks), `dimension_semantics=('parallel',
+  'parallel', 'parallel', 'arbitrary')`. Each grid step streams ONE
+  (block_k, D) K/V tile through the MXU; the online-softmax state (running
+  max m, normalizer l, f32 accumulator) lives in VMEM scratch that persists
+  across the innermost kv dimension. VMEM use is constant in sequence
+  length — attention probabilities never exist in HBM, so memory is O(T)
+  instead of O(T^2) and sequences of 32k+ compile.
+* Causal masking is positional (qpos >= kpos), so the KV length S may
+  exceed the query length T (prefill into a longer zero-filled cache
+  buffer): the zero tail is always masked. Blocks strictly above the
+  causal frontier are skipped: compute is predicated with `pl.when` and
+  their index maps clamp to the last visible block so no fresh DMA is
+  issued for skipped tiles.
+* Backward = two kernels (FlashAttention-2): dq accumulates over kv tiles;
+  dk/dv accumulate over q tiles; both recompute p from the saved
+  logsumexp instead of storing probabilities.
+* GQA never materializes repeated K/V: the kv BlockSpec index maps send
+  query head h to kv head h // group, so the same kv tile serves the whole
+  group straight from HBM (a materialized repeat would multiply KV bytes by
+  the group size at exactly the long-S scales this kernel targets). The
+  backward emits per-query-head dk/dv and group-sums them host-side.
+  Head dims must be sublane multiples (hs % 8); there is no padding path —
+  odd head dims fall back to the XLA impl via `flash_attention_usable`.
+
+The public entry points keep the interface the dispatcher
+(ops/attention_core.py) fixed while this was a stub: `flash_attention` and
+`flash_attention_usable`.
 """
 
 from __future__ import annotations
 
+import functools
+
+import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+_NEG_INF = -1e30  # large-negative instead of -inf: keeps masked rows NaN-free
+
+_SEMANTICS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
+
+
+def _last_visible_kv(i, block_q: int, block_k: int):
+    """Index of the last kv block the q tile `i` attends into (causal)."""
+    return jax.lax.div(i * block_q + block_q - 1, block_k)
+
+
+def _first_visible_q(j, block_q: int, block_k: int):
+    """Index of the first q block that attends into kv tile `j` (causal)."""
+    return jax.lax.div(j * block_k, block_q)
+
+
+def _mask_scores(s, i, j, block_q, block_k):
+    """Causal mask for one (block_q, block_k) score tile. Positions are
+    absolute: qpos = i*block_q + row, kpos = j*block_k + col; a query
+    attends keys with kpos <= qpos (reference model.py:225-226 triu
+    semantics with offset 0)."""
+    qpos = i * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+    kpos = j * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1)
+    return jnp.where(qpos >= kpos, s, _NEG_INF)
+
+
+def _dot(a, b, trans_b=False):
+    dims = (((1,), (1 if trans_b else 0,)), ((), ()))
+    return jax.lax.dot_general(a, b, dims,
+                               preferred_element_type=jnp.float32)
+
+
+def _dot_t(a, b):
+    """a^T @ b with f32 accumulation."""
+    return jax.lax.dot_general(a, b, (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+                *, scale, block_q, block_k):
+    i, j = pl.program_id(2), pl.program_id(3)
+    last_j = _last_visible_kv(i, block_q, block_k)
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    @pl.when(j <= last_j)
+    def _():
+        # operands stay in input dtype (bf16 on TPU): the MXU accumulates in
+        # f32 via preferred_element_type — casting inputs up would force
+        # slow fp32 MXU passes
+        q, k, v = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0]
+        s = _dot(q, k, trans_b=True) * scale             # (bq, bk) f32
+        s = _mask_scores(s, i, j, block_q, block_k)
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + _dot(p.astype(v.dtype), v)
+
+    @pl.when(j == pl.num_programs(3) - 1)
+    def _():
+        l_safe = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_ref[:] + jnp.log(l_safe)
+
+
+def _fwd(q, k, v, scale, block_q, block_k, interpret):
+    """q (B,H,T,D), k/v (B,Hkv,S,D), Hkv | H -> out (B,H,T,D), lse (B,H,T,1)."""
+    B, H, T, D = q.shape
+    S = k.shape[2]
+    rep = H // k.shape[1]
+    nq, nk = T // block_q, S // block_k
+
+    def kv_idx(b, h, i, j):
+        # GQA: query head h reads kv head h // rep — no materialized repeat.
+        # Skipped upper-triangle tiles clamp to the causal frontier so the
+        # revolving-buffer DMA sees an unchanged index (no fetch).
+        return (b, h // rep,
+                jnp.minimum(j, _last_visible_kv(i, block_q, block_k)), 0)
+
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, block_q=block_q,
+                          block_k=block_k),
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D), kv_idx),
+            pl.BlockSpec((1, 1, block_k, D), kv_idx),
+        ],  # k/v arrays keep their Hkv head count; kv_idx maps the group
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            # trailing singleton lane dim: TPU blocks need the last two dims
+            # (8,128)-divisible OR equal to the array dims; (bq, 1) with
+            # array (..., T, 1) qualifies.
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, T, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        compiler_params=_SEMANTICS,
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward (FlashAttention-2: recompute p from lse; delta = rowsum(do * o))
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, scale, block_q, block_k):
+    i, j = pl.program_id(2), pl.program_id(3)
+    last_j = _last_visible_kv(i, block_q, block_k)
+
+    @pl.when(j == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    @pl.when(j <= last_j)
+    def _():
+        q, k, v, do = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0], do_ref[0, 0]
+        s = _dot(q, k, trans_b=True) * scale
+        s = _mask_scores(s, i, j, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0, 0])                  # (bq, bk) f32
+        dp = _dot(do, v, trans_b=True)
+        ds = p * (dp - delta_ref[0, 0])
+        dq_acc[:] = dq_acc[:] + _dot(ds.astype(k.dtype), k)
+
+    @pl.when(j == pl.num_programs(3) - 1)
+    def _():
+        dq_ref[0, 0] = (dq_acc[:] * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, block_q,
+                    block_k):
+    j, i = pl.program_id(2), pl.program_id(3)
+    first_i = _first_visible_q(j, block_q, block_k)
+
+    @pl.when(i == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(i >= first_i)
+    def _():
+        q, k, v, do = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0], do_ref[0, 0]
+        s = _dot(q, k, trans_b=True) * scale            # (bq, bk) f32
+        s = _mask_scores(s, i, j, block_q, block_k)
+        p = jnp.exp(s - lse_ref[0, 0])
+        dv_acc[:] = dv_acc[:] + _dot_t(p.astype(do.dtype), do)
+        dp = _dot(do, v, trans_b=True)
+        ds = p * (dp - delta_ref[0, 0])
+        dk_acc[:] = dk_acc[:] + _dot_t(ds.astype(q.dtype), q)
+
+    @pl.when(i == pl.num_programs(3) - 1)
+    def _():
+        dk_ref[0, 0] = (dk_acc[:] * scale).astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(scale, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    B, H, T, D = q.shape
+    S, Hkv = k.shape[2], k.shape[1]
+    rep = H // Hkv
+    nq, nk = T // block_q, S // block_k
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)                     # (B,H,T,1)
+
+    def kv_idx(b, h, i, j):
+        return (b, h // rep,
+                jnp.minimum(j, _last_visible_kv(i, block_q, block_k)), 0)
+
+    def q_row(b, h, i, j):
+        return (b, h, i, 0)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, block_q=block_q,
+                          block_k=block_k),
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), q_row),
+            pl.BlockSpec((1, 1, block_k, D), kv_idx),
+            pl.BlockSpec((1, 1, block_k, D), kv_idx),
+            pl.BlockSpec((1, 1, block_q, D), q_row),
+            pl.BlockSpec((1, 1, block_q, 1), q_row),
+            pl.BlockSpec((1, 1, block_q, 1), q_row),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, D), q_row),
+        out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=_SEMANTICS,
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    def q_idx(b, h, j, i):
+        # clamp sub-frontier q tiles (skipped compute) to an already-visible
+        # index so no fresh DMA is issued
+        return (b, h, jnp.maximum(i, _first_visible_q(j, block_q, block_k)),
+                0)
+
+    def kv_row(b, h, j, i):
+        return (b, h // rep, j, 0)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
+                          block_k=block_k),
+        grid=(B, H, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), q_idx),
+            pl.BlockSpec((1, 1, block_k, D), kv_row),
+            pl.BlockSpec((1, 1, block_k, D), kv_row),
+            pl.BlockSpec((1, 1, block_q, D), q_idx),
+            pl.BlockSpec((1, 1, block_q, 1), q_idx),
+            pl.BlockSpec((1, 1, block_q, 1), q_idx),
+        ],
+        out_specs=[
+            # per-QUERY-head dk/dv tiles (kv tiles are shared across the
+            # group, so writes would collide at the kv head count);
+            # group-summed below
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, S, D), k.dtype),
+            jax.ShapeDtypeStruct((B, H, S, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=_SEMANTICS,
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    if rep > 1:
+        # jnp.repeat is interleaved: query head h <- kv head h // rep
+        dk = dk.reshape(B, Hkv, rep, S, D).sum(axis=2)
+        dv = dv.reshape(B, Hkv, rep, S, D).sum(axis=2)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, scale, block_q, block_k, interpret):
+    out, _ = _fwd(q, k, v, scale, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, scale, block_q, block_k, interpret):
+    out, lse = _fwd(q, k, v, scale, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+_flash.defvjp(_flash_fwd, _bwd)
+
+
+# ---------------------------------------------------------------------------
+# public entry points (interface fixed by ops/attention_core.py)
+# ---------------------------------------------------------------------------
+
+def _pick_block(n: int, preferred: int) -> int:
+    """Largest divisor of n that is <= preferred and a multiple of 8."""
+    b = min(preferred, n)
+    while b > 8 and (n % b != 0):
+        b -= 8
+    return b if n % b == 0 else 0
 
 
 def flash_attention_usable(q, k, v, *, causal: bool = True) -> bool:
-    return False
+    """Static gate for the dispatcher: shapes/dtypes this kernel handles."""
+    if not causal:
+        return False
+    B, T, nh, hs = q.shape
+    S = k.shape[1]
+    if q.dtype not in (jnp.float32, jnp.bfloat16):
+        return False
+    if T < 8 or S < 8:
+        return False  # decode-step shapes: the naive path is fine
+    if hs % 8 != 0:
+        return False
+    return bool(_pick_block(T, DEFAULT_BLOCK_Q)
+                and _pick_block(S, DEFAULT_BLOCK_K))
 
 
 def flash_attention(q, k, v, *, scale: float, causal: bool = True,
-                    q_offset=0) -> jnp.ndarray:
-    raise NotImplementedError("Pallas flash attention not yet implemented")
+                    q_offset=0, block_q: int = 0, block_k: int = 0,
+                    interpret: bool = False) -> jnp.ndarray:
+    """Causal flash attention over BTNH-layout tensors.
+
+    q: (B, T, nh, hs); k, v: (B, S, nkv, hs) with nkv | nh. `q_offset`
+    must be a static 0 (prefill/training; the dispatcher routes
+    cached-decode offsets — including traced ones — to the naive path).
+    GQA kv heads are shared via the kernel's index maps; K/V are never
+    materialized per query head.
+    """
+    assert causal, "flash kernel is causal-only; use impl='xla' otherwise"
+    assert isinstance(q_offset, int) and q_offset == 0, (
+        "flash kernel requires a static q_offset == 0; cached-decode "
+        "offsets must use the naive path")
+    B, T, nh, hs = q.shape
+    S, nkv = k.shape[1], k.shape[2]
+    assert hs % 8 == 0, "head dim must be a multiple of 8 (sublane)"
+    assert nh % nkv == 0, "query heads must be a multiple of kv heads"
+
+    block_q = block_q or _pick_block(T, DEFAULT_BLOCK_Q)
+    block_k = block_k or _pick_block(S, DEFAULT_BLOCK_K)
+    assert block_q and T % block_q == 0 and block_k and S % block_k == 0, (
+        f"no usable block split for T={T}, S={S} — gate with "
+        f"flash_attention_usable first")
+
+    # BTNH -> BHTD for tile-contiguous blocks
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    out = _flash(qt, kt, vt, float(scale), block_q, block_k, interpret)
+    return jnp.transpose(out, (0, 2, 1, 3))

@@ -58,14 +58,15 @@ def main(argv=None) -> None:
           f"{model_cfg.n_layer}L/{model_cfg.n_embd}d {model_cfg.attn}")
 
     # Shapes only (jax.eval_shape): no concrete init of params or AdamW
-    # moments just to learn the checkpoint's structure.
+    # moments just to learn the checkpoint's structure; restore skips the
+    # optimizer moments entirely (placeholder leaves).
     model = build_model(model_cfg, train_cfg)
     tx = make_optimizer(train_cfg)
     abstract = jax.eval_shape(
         lambda r: init_train_state(r, model, model_cfg, tx,
                                    batch_size=train_cfg.batch_size),
         jax.random.PRNGKey(0))
-    state = ckpt.restore_checkpoint(path, abstract)
+    state = ckpt.restore_for_inference(path, abstract)
     variables = {"params": state.params}
     if state.moe_state:
         variables["moe_state"] = state.moe_state

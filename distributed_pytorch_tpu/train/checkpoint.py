@@ -73,11 +73,36 @@ def restore_checkpoint(path: str, abstract_state: Any,
         one = jax.sharding.SingleDeviceSharding(jax.local_devices()[0])
         state_sharding = jax.tree_util.tree_map(lambda s: one, abstract_state)
     abstract_state = jax.tree_util.tree_map(
-        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        lambda s, sh: s if s is ocp.PLACEHOLDER else
+        jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
         abstract_state, state_sharding)
     with ocp.StandardCheckpointer() as ckptr:
         return ckptr.restore(os.path.join(_abs(path), "state"),
                              abstract_state)
+
+
+def restore_for_inference(path: str, abstract_state: Any) -> TrainState:
+    """Restore ONLY params + moe_state (opt_state leaves are skipped via
+    orbax PLACEHOLDER, which StandardCheckpointer rejects but the PyTree
+    handler honors): the sampling CLI reads a third of the bytes a full
+    TrainState restore would."""
+    one = jax.sharding.SingleDeviceSharding(jax.local_devices()[0])
+    abstract_state = dataclasses.replace(
+        jax.tree_util.tree_map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=one),
+            abstract_state),
+        opt_state=jax.tree_util.tree_map(lambda _: ocp.PLACEHOLDER,
+                                         abstract_state.opt_state))
+    restore_args = jax.tree_util.tree_map(
+        lambda s: s if s is ocp.PLACEHOLDER else
+        ocp.checkpoint_utils.construct_restore_args(s),
+        abstract_state)
+    with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:
+        state = ckptr.restore(
+            os.path.join(_abs(path), "state"),
+            args=ocp.args.PyTreeRestore(item=abstract_state,
+                                        restore_args=restore_args))
+    return dataclasses.replace(state, opt_state=None)
 
 
 def latest_step_dir(root: str) -> Optional[str]:

@@ -1,0 +1,136 @@
+"""Pallas flash-attention kernel vs the naive einsum oracle.
+
+Runs the kernel in interpret mode (no TPU needed) and checks forward and
+backward numerics against `_naive_sdpa` — the reference-semantics path
+(reference model.py:149 SDPA / :225-226 causal mask).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pytorch_tpu.ops.attention_core import _naive_sdpa
+from distributed_pytorch_tpu.ops.flash_attention import (
+    flash_attention, flash_attention_usable)
+
+
+def rand_qkv(key, B, T, S, nh, nkv, hs, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, T, nh, hs), dtype)
+    k = jax.random.normal(kk, (B, S, nkv, hs), dtype)
+    v = jax.random.normal(kv, (B, S, nkv, hs), dtype)
+    return q, k, v
+
+
+CASES = [
+    # (T, S, nh, nkv, hs, block)
+    (128, 128, 4, 4, 32, 64),     # MHA, small head dim
+    (256, 256, 4, 2, 64, 128),    # GQA group 2
+    (128, 128, 4, 1, 64, 64),     # MQA
+    (64, 256, 2, 2, 64, 64),      # prefill: S > T (cache buffer tail masked)
+    (96, 96, 2, 2, 64, 32),       # non-power-of-two T, odd block split
+]
+
+
+@pytest.mark.parametrize("T,S,nh,nkv,hs,block", CASES)
+def test_forward_matches_naive(T, S, nh, nkv, hs, block):
+    q, k, v = rand_qkv(jax.random.PRNGKey(0), 2, T, S, nh, nkv, hs)
+    scale = 1.0 / hs ** 0.5
+    out = flash_attention(q, k, v, scale=scale, block_q=block, block_k=block,
+                          interpret=True)
+    ref = _naive_sdpa(q, k, v, scale=scale, q_offset=0, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_backward_matches_naive():
+    T, nh, nkv, hs = 128, 4, 2, 64
+    q, k, v = rand_qkv(jax.random.PRNGKey(1), 2, T, T, nh, nkv, hs)
+    scale = 1.0 / hs ** 0.5
+    w = jax.random.normal(jax.random.PRNGKey(2), q.shape)
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, scale=scale, block_q=64, block_k=64,
+                              interpret=True)
+        return jnp.sum(out * w)
+
+    def loss_naive(q, k, v):
+        return jnp.sum(_naive_sdpa(q, k, v, scale=scale, q_offset=0,
+                                   causal=True) * w)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gn = jax.grad(loss_naive, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gn, "q k v".split()):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
+                                   atol=2e-4, err_msg=f"d{name} mismatch")
+
+
+def test_bf16_forward_close():
+    T, nh, hs = 128, 2, 64
+    q, k, v = rand_qkv(jax.random.PRNGKey(3), 1, T, T, nh, nh, hs,
+                       dtype=jnp.bfloat16)
+    scale = 1.0 / hs ** 0.5
+    out = flash_attention(q, k, v, scale=scale, block_q=64, block_k=64,
+                          interpret=True)
+    ref = _naive_sdpa(q, k, v, scale=scale, q_offset=0, causal=True)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_usable_gate():
+    q, k, v = rand_qkv(jax.random.PRNGKey(0), 1, 128, 128, 2, 2, 64)
+    assert flash_attention_usable(q, k, v, causal=True)
+    assert not flash_attention_usable(q, k, v, causal=False)
+    # decode-step shape: single query row -> naive path
+    assert not flash_attention_usable(q[:, :1], k, v, causal=True)
+    # fp16 not supported on TPU path
+    assert not flash_attention_usable(
+        q.astype(jnp.float16), k.astype(jnp.float16), v.astype(jnp.float16),
+        causal=True)
+
+
+def test_model_trains_with_pallas_interpret(monkeypatch):
+    """End-to-end: the GQA module routed through the pallas impl (interpret
+    mode via monkeypatched pallas_call) matches the xla impl."""
+    import distributed_pytorch_tpu.ops.flash_attention as fa
+    import jax.experimental.pallas as pl
+
+    orig = pl.pallas_call
+    monkeypatch.setattr(
+        fa.pl, "pallas_call",
+        lambda *a, **kw: orig(*a, **{**kw, "interpret": True}))
+    # force the dispatcher to believe pallas is available
+    import distributed_pytorch_tpu.ops.attention_core as core
+    monkeypatch.setattr(core, "_on_tpu", lambda: True)
+
+    from distributed_pytorch_tpu.config import LLMConfig
+    from distributed_pytorch_tpu.models.gpt import LLM
+
+    cfg = LLMConfig(vocab_size=128, block_size=64, n_embd=64, n_head=4,
+                    n_kv_heads=2, attn="gqa", n_layer=2, up_dim=128,
+                    non_linearity="swiglu", pos_emb="rope")
+    x = jax.random.randint(jax.random.PRNGKey(0), (2, 64), 0, 128, jnp.int32)
+
+    def run(impl):
+        model = LLM(cfg, attn_impl=impl)
+        variables = model.init(jax.random.PRNGKey(5), x, x)
+
+        def loss(params):
+            _, l, _ = model.apply({"params": params}, x, x)
+            return l
+        l, g = jax.value_and_grad(loss)(variables["params"])
+        return l, g
+
+    l_p, g_p = run("pallas")
+    l_x, g_x = run("xla")
+    np.testing.assert_allclose(float(l_p), float(l_x), rtol=1e-5)
+    flat_p = jax.tree_util.tree_leaves(g_p)
+    flat_x = jax.tree_util.tree_leaves(g_x)
+    for a, b in zip(flat_p, flat_x):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4,
+                                   atol=5e-4)

@@ -153,9 +153,10 @@ class TrainConfig:
     ep_size: int = 1                 # expert axis size (ep)
     sp_size: int = 1                 # sequence axis size (sp / ring attention)
     compute_dtype: str = "bfloat16"  # bf16 compute, fp32 params/opt state
-    # attention kernel choice; ring attention is selected via the 'sp'
-    # parallelism recipe (a sharding concern), not here
-    attn_impl: str = "auto"          # 'auto' | 'xla' | 'pallas' | 'naive'
+    # attention kernel choice; under the 'sp' recipe, 'auto' and 'ring'
+    # select ring attention over the 'seq' axis, 'ulysses' the all-to-all
+    # head<->sequence variant (ops/ring_attention.py)
+    attn_impl: str = "auto"  # auto | xla | pallas | naive | ring | ulysses
     moe_impl: str = "dense"          # 'dense' | 'scatter'
     # checkpoint/resume (exceeds reference save-only; SURVEY.md §5)
     ckpt_interval: int = 0           # 0 = end-of-run only
@@ -169,7 +170,8 @@ class TrainConfig:
         assert self.moe_impl in ("dense",), \
             "moe_impl 'scatter' (capacity-bounded sort dispatch) is planned " \
             "but not yet implemented; use 'dense'"
-        assert self.attn_impl in ("auto", "xla", "pallas", "naive"), \
+        assert self.attn_impl in ("auto", "xla", "pallas", "naive", "ring",
+                                  "ulysses"), \
             f"unknown attn_impl {self.attn_impl!r}"
 
 

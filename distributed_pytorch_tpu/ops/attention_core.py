@@ -82,11 +82,44 @@ def sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     hs = q.shape[-1]
     scale = (1.0 / hs ** 0.5) if scale is None else scale
 
-    if impl not in ("auto", "pallas", "xla", "naive"):
+    if impl not in ("auto", "pallas", "xla", "naive", "ring", "ulysses"):
         raise ValueError(f"unknown attention impl {impl!r}; expected "
-                         "'auto' | 'pallas' | 'xla' | 'naive'")
+                         "'auto' | 'pallas' | 'xla' | 'naive' | 'ring' | "
+                         "'ulysses'")
 
     use_dropout = dropout_rate > 0.0 and dropout_rng is not None
+
+    # Sequence parallelism: when the ambient mesh (parallel/context.py) has
+    # a live 'seq' axis and shapes allow, full-sequence causal attention
+    # runs as ring/Ulysses over explicit 'seq' collectives instead of
+    # letting GSPMD all-gather the whole sequence per device.
+    # NOTE: this routing is a trace-time decision — the ambient mesh is not
+    # part of jax.jit's cache key. Callers must establish context.use_mesh
+    # BEFORE the first (tracing) call of their jitted function, as the
+    # trainer's step builders do (train/step.py); a function first traced
+    # without the mesh keeps its GSPMD full-gather path.
+    if not use_dropout:
+        from distributed_pytorch_tpu.parallel import context
+        sp = context.seq_axis_size()
+        if sp > 1 and not context.in_sp_region() \
+                and impl in ("auto", "ring", "ulysses"):
+            static_zero = isinstance(q_offset, int) and q_offset == 0
+            mesh = context.get_mesh()
+            dp = mesh.shape["data"]
+            T, S, B = q.shape[1], k.shape[1], q.shape[0]
+            sp_ok = (causal and static_zero and T == S and T % sp == 0
+                     and B % dp == 0 and T // sp > 0)
+            if sp_ok:
+                from distributed_pytorch_tpu.ops.ring_attention import sp_sdpa
+                sp_impl = "ulysses" if impl == "ulysses" else "ring"
+                if (sp_impl == "ulysses"
+                        and (q.shape[2] % sp or k.shape[2] % sp)):
+                    sp_impl = "ring"  # head counts not sp-divisible
+                return sp_sdpa(q, k, v, scale=scale, causal=causal,
+                               impl=sp_impl)
+        if impl in ("ring", "ulysses"):
+            impl = "auto"  # shapes/mesh don't allow sp (e.g. decode steps)
+
     if use_dropout:
         # only the naive path implements attention-weight dropout; honoring
         # the caller's dropout beats honoring their impl choice

@@ -1,0 +1,172 @@
+"""Sequence/context-parallel attention over the 'seq' mesh axis: ring
+attention (ppermute KV rotation + online softmax) and the Ulysses
+all-to-all head<->sequence reshard variant.
+
+This is a capability the reference lacks entirely (SURVEY.md §5
+"Long-context: entirely absent" — its max context is block_size with an
+O(T^2) materialized mask, model.py:225). Design per the scaling-book /
+Ring Attention (arXiv:2310.01889) and DeepSpeed-Ulysses (arXiv:2309.14509)
+recipes:
+
+* **Ring**: every device holds a (B, T/sp, H, D) shard of q/k/v. For sp
+  steps, each device attends its local q against the resident kv chunk and
+  accumulates with the online-softmax recurrence (running max m,
+  normalizer l, f32 accumulator — the same math as the Pallas flash
+  kernel, ops/flash_attention.py), then rotates k/v one hop around the
+  ring with `jax.lax.ppermute` over ICI neighbors. KV chunks whose global
+  positions lie entirely in the causal future contribute zero via the
+  positional mask (compute is not skipped — a uniform schedule keeps every
+  ring hop the same length; documented 2x-FLOPs-of-optimal tradeoff).
+  Each step is wrapped in `jax.checkpoint` so the backward rematerializes
+  the per-chunk probabilities instead of storing sp O((T/sp)^2) slabs.
+* **Ulysses**: `all_to_all` resharding (B, T/sp, H, D) -> (B, T, H/sp, D),
+  ONE local full-sequence causal attention per head subset (which can use
+  the Pallas flash kernel), then the inverse all_to_all. Cheaper compute
+  (no redundant masked blocks), but requires sp | H (and sp | n_kv_heads),
+  and moves activations twice over the interconnect.
+
+Both are *local* functions meant to run inside `shard_map`; `sp_sdpa`
+wraps them for the dispatcher, reading the ambient mesh
+(parallel/context.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _local_scores(q, k, scale):
+    """(B, Tq, H, D) x (B, Tk, Hkv, D) -> (B, H, Tq, Tk) f32 scores, with
+    GQA kv-head repeat."""
+    nh, nkv = q.shape[2], k.shape[2]
+    if nkv != nh:
+        k = jnp.repeat(k, nh // nkv, axis=2)
+    return jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                      preferred_element_type=jnp.float32) * scale
+
+
+def _chunk_update(carry, q, k, v, qo, ko, scale, causal):
+    """One online-softmax accumulation of local q against one kv chunk.
+
+    qo/ko: global token offsets of the q and kv chunks (traced scalars).
+    carry: (acc (B,H,Tq,D) f32, m (B,H,Tq,1) f32, l (B,H,Tq,1) f32).
+    """
+    acc, m, l = carry
+    B, Tq, nh, D = q.shape
+    Tk = k.shape[1]
+    s = _local_scores(q, k, scale)                     # (B,H,Tq,Tk)
+    if causal:
+        qpos = qo + jnp.arange(Tq)[:, None]
+        kpos = ko + jnp.arange(Tk)[None, :]
+        s = jnp.where((qpos >= kpos)[None, None], s, _NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m - m_new)
+    p = jnp.exp(s - m_new)                             # (B,H,Tq,Tk)
+    nkv = v.shape[2]
+    if nkv != nh:
+        v = jnp.repeat(v, nh // nkv, axis=2)
+    pv = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
+    l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc = acc * alpha + pv
+    return acc, m_new, l
+
+
+def ring_attention_local(q, k, v, *, scale: float, axis_name: str = "seq",
+                         sp: int, causal: bool = True) -> jnp.ndarray:
+    """Ring attention body (call inside shard_map). q/k/v: local
+    (B, T/sp, H|Hkv, D) shards, contiguous sequence layout (shard i holds
+    global positions [i*Tloc, (i+1)*Tloc))."""
+    idx = jax.lax.axis_index(axis_name)
+    B, Tloc, nh, D = q.shape
+    qo = idx * Tloc
+
+    acc = jnp.zeros((B, nh, Tloc, D), jnp.float32)
+    m = jnp.full((B, nh, Tloc, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((B, nh, Tloc, 1), jnp.float32)
+
+    step_fn = jax.checkpoint(functools.partial(_chunk_update, scale=scale,
+                                               causal=causal))
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    carry = (acc, m, l)
+    for s in range(sp):
+        # after s hops the resident chunk originated at ring position
+        # (idx - s) mod sp
+        ko = ((idx - s) % sp) * Tloc
+        carry = step_fn(carry, q, k, v, qo, ko)
+        if s < sp - 1:
+            k = jax.lax.ppermute(k, axis_name, perm)
+            v = jax.lax.ppermute(v, axis_name, perm)
+
+    acc, m, l = carry
+    out = acc / jnp.maximum(l, 1e-30)
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def ulysses_attention_local(q, k, v, *, scale: float, axis_name: str = "seq",
+                            sp: int, causal: bool = True,
+                            attn_impl: str = "auto") -> jnp.ndarray:
+    """Ulysses body (call inside shard_map): all_to_all heads<->sequence,
+    local full-sequence attention (impl='auto' engages the Pallas flash
+    kernel at long T; context.sp_region blocks re-entry into the sp path),
+    inverse all_to_all. Requires sp | nh and sp | n_kv_heads."""
+    from distributed_pytorch_tpu.ops.attention_core import sdpa
+
+    # (B, T/sp, H, D) -> (B, T, H/sp, D): split heads, gather sequence
+    qg = jax.lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1,
+                            tiled=True)
+    kg = jax.lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1,
+                            tiled=True)
+    vg = jax.lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1,
+                            tiled=True)
+    out = sdpa(qg, kg, vg, causal=causal, scale=scale, impl=attn_impl)
+    # (B, T, H/sp, D) -> (B, T/sp, H, D)
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
+def sp_sdpa(q, k, v, *, scale: float, causal: bool = True,
+            impl: str = "ring", attn_impl: str = "auto") -> jnp.ndarray:
+    """Dispatcher entry: run ring/Ulysses attention over the ambient mesh's
+    'seq' axis via shard_map. q (B,T,nh,hs), k/v (B,S,nkv,hs) are LOGICAL
+    (full-sequence) arrays inside the enclosing jit; shard_map splits them
+    (B over 'data', T over 'seq').
+
+    Requires S == T (training/prefill full-sequence shapes; KV-cached
+    decode never routes here)."""
+    from distributed_pytorch_tpu.parallel import context
+
+    mesh = context.get_mesh()
+    sp = context.seq_axis_size()
+    assert mesh is not None and sp > 1
+    assert q.shape[1] == k.shape[1], (
+        "sequence-parallel attention requires q and kv of equal length "
+        f"(got {q.shape[1]} vs {k.shape[1]})")
+
+    if impl == "ulysses":
+        nkv = k.shape[2]
+        assert q.shape[2] % sp == 0 and nkv % sp == 0, (
+            f"ulysses needs sp={sp} dividing n_head={q.shape[2]} and "
+            f"n_kv_heads={nkv}; use ring attention instead")
+        body = functools.partial(ulysses_attention_local, scale=scale,
+                                 sp=sp, causal=causal, attn_impl=attn_impl)
+    else:
+        body = functools.partial(ring_attention_local, scale=scale, sp=sp,
+                                 causal=causal)
+
+    def shard_body(a, b, c):
+        with context.sp_region():   # no recursive sp routing inside
+            return body(a, b, c)
+
+    spec = P("data", "seq", None, None)
+    fn = jax.shard_map(shard_body, mesh=mesh,
+                       in_specs=(spec, spec, spec), out_specs=spec)
+    return fn(q, k, v)

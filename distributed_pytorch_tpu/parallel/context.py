@@ -1,0 +1,61 @@
+"""Ambient mesh context.
+
+The model modules are mesh-oblivious (sharding is a recipe concern,
+parallel/sharding.py), but sequence-parallel attention has to issue
+explicit collectives over the 'seq' axis from *inside* the traced model.
+The trainer publishes its mesh here; the attention dispatcher
+(ops/attention_core.py) picks ring/Ulysses when the ambient mesh has a
+live 'seq' axis. This replaces nothing in the reference — its NCCL process
+group is ambient global state too (torch.distributed default group,
+multi-gpu/ddp/train.py:19), just implicit.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from contextvars import ContextVar
+from typing import Optional
+
+from jax.sharding import Mesh
+
+_current_mesh: ContextVar[Optional[Mesh]] = ContextVar("current_mesh",
+                                                       default=None)
+
+
+def get_mesh() -> Optional[Mesh]:
+    return _current_mesh.get()
+
+
+def seq_axis_size() -> int:
+    mesh = get_mesh()
+    if mesh is None or "seq" not in mesh.axis_names:
+        return 1
+    return mesh.shape["seq"]
+
+
+_in_sp_region: ContextVar[bool] = ContextVar("in_sp_region", default=False)
+
+
+def in_sp_region() -> bool:
+    """True while tracing inside a sequence-parallel shard_map body — the
+    attention dispatcher must not recursively re-enter the sp path there
+    (the local shapes can accidentally satisfy the routing conditions)."""
+    return _in_sp_region.get()
+
+
+@contextlib.contextmanager
+def sp_region():
+    token = _in_sp_region.set(True)
+    try:
+        yield
+    finally:
+        _in_sp_region.reset(token)
+
+
+@contextlib.contextmanager
+def use_mesh(mesh: Optional[Mesh]):
+    token = _current_mesh.set(mesh)
+    try:
+        yield mesh
+    finally:
+        _current_mesh.reset(token)

@@ -73,8 +73,7 @@ def restore_checkpoint(path: str, abstract_state: Any,
         one = jax.sharding.SingleDeviceSharding(jax.local_devices()[0])
         state_sharding = jax.tree_util.tree_map(lambda s: one, abstract_state)
     abstract_state = jax.tree_util.tree_map(
-        lambda s, sh: s if s is ocp.PLACEHOLDER else
-        jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
         abstract_state, state_sharding)
     with ocp.StandardCheckpointer() as ckptr:
         return ckptr.restore(os.path.join(_abs(path), "state"),

@@ -23,7 +23,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from distributed_pytorch_tpu.config import LLMConfig, TrainConfig
-from distributed_pytorch_tpu.parallel import sharding as shd
+from distributed_pytorch_tpu.parallel import context, sharding as shd
 from distributed_pytorch_tpu.train.state import TrainState
 
 # Recipes whose gradient accumulator is constrained sharded over 'data'
@@ -70,6 +70,12 @@ def make_train_step(model, tx: optax.GradientTransformation,
         return loss, new_moe
 
     def train_step(state: TrainState, x: jnp.ndarray, y: jnp.ndarray):
+        # publish the mesh for the duration of TRACING: sequence-parallel
+        # attention (ops/ring_attention.py) reads it to shard_map over 'seq'
+        with context.use_mesh(mesh):
+            return _train_step_body(state, x, y)
+
+    def _train_step_body(state: TrainState, x: jnp.ndarray, y: jnp.ndarray):
         accum = x.shape[0]
         base_rng = jax.random.fold_in(
             jax.random.PRNGKey(train_cfg.seed), state.step)
@@ -137,11 +143,12 @@ def make_eval_step(model, train_cfg: TrainConfig,
     — under pjit the loss is over the GLOBAL batch."""
 
     def eval_step(state: TrainState, x, y):
-        variables = {"params": state.params}
-        if state.moe_state:
-            variables["moe_state"] = state.moe_state
-        _, loss, _ = model.apply(variables, x, y, deterministic=True)
-        return loss
+        with context.use_mesh(mesh):
+            variables = {"params": state.params}
+            if state.moe_state:
+                variables["moe_state"] = state.moe_state
+            _, loss, _ = model.apply(variables, x, y, deterministic=True)
+            return loss
 
     if mesh is None:
         return jax.jit(eval_step)

@@ -1,0 +1,140 @@
+"""Sequence-parallel attention (ring + Ulysses) vs the full-sequence
+oracle, on the 8-device CPU mesh (SURVEY.md §5 long-context — a capability
+the reference lacks; these tests are its correctness contract)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pytorch_tpu.ops.attention_core import _naive_sdpa, sdpa
+from distributed_pytorch_tpu.ops.ring_attention import sp_sdpa
+from distributed_pytorch_tpu.parallel import context
+from distributed_pytorch_tpu.parallel.mesh import MeshPlan, build_mesh
+
+
+def rand_qkv(key, B, T, nh, nkv, hs):
+    kq, kk, kv = jax.random.split(key, 3)
+    return (jax.random.normal(kq, (B, T, nh, hs)),
+            jax.random.normal(kk, (B, T, nkv, hs)),
+            jax.random.normal(kv, (B, T, nkv, hs)))
+
+
+@pytest.fixture
+def mesh24():
+    return build_mesh(MeshPlan(data=2, seq=4))
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+def test_sp_matches_full_attention(mesh24, impl):
+    B, T, nh, hs = 4, 128, 4, 16
+    q, k, v = rand_qkv(jax.random.PRNGKey(0), B, T, nh, nh, hs)
+    scale = 1.0 / hs ** 0.5
+    ref = _naive_sdpa(q, k, v, scale=scale, q_offset=0, causal=True)
+    with context.use_mesh(mesh24):
+        out = jax.jit(lambda q, k, v: sp_sdpa(q, k, v, scale=scale,
+                                              impl=impl))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_gqa(mesh24):
+    B, T, nh, nkv, hs = 2, 64, 4, 2, 16
+    q, k, v = rand_qkv(jax.random.PRNGKey(1), B, T, nh, nkv, hs)
+    scale = 1.0 / hs ** 0.5
+    ref = _naive_sdpa(q, k, v, scale=scale, q_offset=0, causal=True)
+    with context.use_mesh(mesh24):
+        out = jax.jit(lambda q, k, v: sp_sdpa(q, k, v, scale=scale,
+                                              impl="ring"))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_gradients_match(mesh24):
+    B, T, nh, hs = 2, 64, 4, 16
+    q, k, v = rand_qkv(jax.random.PRNGKey(2), B, T, nh, nh, hs)
+    scale = 1.0 / hs ** 0.5
+    w = jax.random.normal(jax.random.PRNGKey(3), q.shape)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(sp_sdpa(q, k, v, scale=scale, impl="ring") * w)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_naive_sdpa(q, k, v, scale=scale, q_offset=0,
+                                   causal=True) * w)
+
+    with context.use_mesh(mesh24):
+        gr = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    gn = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gr, gn, "q k v".split()):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
+                                   atol=2e-4, err_msg=f"d{name} mismatch")
+
+
+def test_sdpa_auto_routes_to_ring(mesh24):
+    """Under an ambient mesh with seq>1, impl='auto' must use the sp path
+    (same numbers as the oracle) without the caller doing anything."""
+    B, T, nh, hs = 2, 64, 4, 16
+    q, k, v = rand_qkv(jax.random.PRNGKey(4), B, T, nh, nh, hs)
+    ref = sdpa(q, k, v, causal=True, impl="naive")
+    with context.use_mesh(mesh24):
+        out = jax.jit(lambda q, k, v: sdpa(q, k, v, causal=True,
+                                           impl="auto"))(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_sdpa_decode_shapes_bypass_sp(mesh24):
+    """KV-cached decode (T=1, q_offset traced) must not try shard_map."""
+    B, nh, hs, S = 2, 4, 16, 64
+    q = jax.random.normal(jax.random.PRNGKey(5), (B, 1, nh, hs))
+    k = jax.random.normal(jax.random.PRNGKey(6), (B, S, nh, hs))
+    v = jax.random.normal(jax.random.PRNGKey(7), (B, S, nh, hs))
+    with context.use_mesh(mesh24):
+        out = sdpa(q, k, v, causal=True, q_offset=jnp.int32(S - 1),
+                   impl="auto")
+    ref = sdpa(q, k, v, causal=True, q_offset=jnp.int32(S - 1), impl="naive")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-6,
+                               atol=1e-6)
+
+
+def test_sp_training_step_with_ring_matches_oracle():
+    """End-to-end: the sp recipe's train step (ring attention active via
+    'auto') reproduces the single-device optimizer step."""
+    from distributed_pytorch_tpu.config import LLMConfig, TrainConfig
+    from distributed_pytorch_tpu.parallel import sharding as shd
+    from distributed_pytorch_tpu.parallel.mesh import resolve_plan
+    from distributed_pytorch_tpu.train.state import create_train_state
+    from distributed_pytorch_tpu.train.step import make_train_step
+    from jax.sharding import NamedSharding
+
+    mc = LLMConfig(vocab_size=128, block_size=32, n_embd=32, n_head=4,
+                   n_kv_heads=4, n_layer=2, up_dim=64, pos_emb="rope",
+                   attn="mha")
+    rng = np.random.default_rng(0)
+    x = rng.integers(0, 128, size=(2, 4, 32)).astype(np.int32)
+    y = rng.integers(0, 128, size=(2, 4, 32)).astype(np.int32)
+
+    def run(recipe, mesh, **kw):
+        tc = TrainConfig(total_batch_size=2 * 4 * 32, batch_size=4,
+                         parallelism=recipe, **kw)
+        model, tx, state, st_sh = create_train_state(mc, tc, mesh)
+        step = make_train_step(model, tx, mc, tc, mesh, st_sh)
+        xb, yb = jnp.asarray(x), jnp.asarray(y)
+        if mesh is not None:
+            bsh = NamedSharding(mesh, shd.batch_pspec(recipe, mesh,
+                                                      leading_accum=True))
+            xb = jax.device_put(xb, bsh)
+            yb = jax.device_put(yb, bsh)
+        state, m = step(state, xb, yb)
+        return float(m["loss"]), jax.device_get(state.params)
+
+    loss_1, params_1 = run("single", None)
+    mesh = build_mesh(resolve_plan("sp", 8, sp_size=4))
+    loss_sp, params_sp = run("sp", mesh, sp_size=4)
+    assert abs(loss_1 - loss_sp) < 1e-4, (loss_1, loss_sp)
+    flat1 = jax.tree_util.tree_leaves(params_1)
+    flat2 = jax.tree_util.tree_leaves(params_sp)
+    for a, b in zip(flat1, flat2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
+                                   atol=2e-4)

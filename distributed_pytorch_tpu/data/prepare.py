@@ -1,0 +1,84 @@
+"""Shared ETL helpers for the dataset prepare scripts.
+
+Reference parity: both reference prepare scripts tokenize with tiktoken's
+GPT-2 BPE and write RAW uint16 token files (`data/shakespeare/prepare.py:
+7-36`, `data/tinystories/prepare.py:13-52`) — the exact format this
+package's DataLoader memmaps, so .bin files prepared by either codebase
+are interchangeable.
+
+Tokenizer resolution order: tiktoken GPT-2 BPE (the reference's choice) →
+HuggingFace GPT2TokenizerFast (local cache only) → byte-level fallback
+(vocab 256; keeps the pipeline runnable in air-gapped environments like
+this one, with a loud warning since the vocabulary differs).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from typing import Callable, Optional
+
+import numpy as np
+
+GPT2_EOT = 50256
+
+
+def get_tokenizer(prefer: str = "auto"):
+    """Return (encode_fn, eot_id, name). encode_fn: str -> list[int]."""
+    if prefer in ("auto", "gpt2"):
+        try:
+            import tiktoken
+            enc = tiktoken.get_encoding("gpt2")
+            enc.encode("probe")  # force lazy vocab fetch now
+            return (lambda s: enc.encode_ordinary(s)), GPT2_EOT, "gpt2-bpe"
+        except Exception:
+            pass
+        try:
+            os.environ.setdefault("HF_HUB_OFFLINE", "1")
+            os.environ.setdefault("TRANSFORMERS_OFFLINE", "1")
+            from transformers import GPT2TokenizerFast
+            tok = GPT2TokenizerFast.from_pretrained("gpt2")
+            return (lambda s: tok.encode(s)), GPT2_EOT, "gpt2-bpe-hf"
+        except Exception:
+            pass
+        if prefer == "gpt2":
+            raise RuntimeError(
+                "GPT-2 BPE unavailable: tiktoken could not fetch its vocab "
+                "(no network?) and no local HuggingFace gpt2 cache exists. "
+                "Use --tokenizer byte for an air-gapped run.")
+    if prefer in ("auto", "byte"):
+        print("[prepare] WARNING: GPT-2 BPE unavailable (no network, no "
+              "cache) — falling back to byte-level tokens (vocab 256). "
+              "Models trained on these bins need vocab_size >= 257.",
+              file=sys.stderr)
+        return (lambda s: list(s.encode("utf-8"))), 256, "byte"
+    raise ValueError(f"unknown tokenizer preference {prefer!r}")
+
+
+def write_bin(tokens, path: str) -> int:
+    """Write a uint16 raw token file (reference prepare.py:30-36 format)."""
+    arr = np.asarray(tokens, dtype=np.uint16)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    arr.tofile(path)
+    print(f"[prepare] wrote {path}: {arr.size:,} tokens")
+    return arr.size
+
+
+def read_text(input_path: Optional[str], url: str, cache_path: str) -> str:
+    """Load corpus text: local --input file if given, else download `url`
+    to `cache_path` (reference downloads unconditionally,
+    data/shakespeare/prepare.py:10-15)."""
+    if input_path:
+        with open(input_path, encoding="utf-8") as f:
+            return f.read()
+    if not os.path.exists(cache_path):
+        import urllib.request
+        os.makedirs(os.path.dirname(cache_path) or ".", exist_ok=True)
+        print(f"[prepare] downloading {url}")
+        # download to a temp name, promote atomically: an interrupted fetch
+        # must not leave a partial file that later runs silently reuse
+        tmp = cache_path + ".part"
+        urllib.request.urlretrieve(url, tmp)
+        os.replace(tmp, cache_path)
+    with open(cache_path, encoding="utf-8") as f:
+        return f.read()

@@ -1,0 +1,43 @@
+"""Prepare tinyshakespeare: download → GPT-2 BPE → 90/10 split →
+uint16 train.bin/val.bin.
+
+Reference parity (`data/shakespeare/prepare.py:7-36`): same source URL,
+same 90/10 contiguous split, same raw-uint16 output format. Additions:
+`--input` for an air-gapped local corpus and `--out_dir` (the reference
+hardcodes its own directory).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+from distributed_pytorch_tpu.data.prepare import (get_tokenizer, read_text,
+                                                  write_bin)
+
+URL = ("https://raw.githubusercontent.com/karpathy/char-rnn/master/data/"
+       "tinyshakespeare/input.txt")
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="Prepare tinyshakespeare .bins")
+    p.add_argument("--out_dir", default="data/shakespeare")
+    p.add_argument("--input", default=None,
+                   help="local corpus text file (skips the download)")
+    p.add_argument("--tokenizer", default="auto",
+                   choices=["auto", "gpt2", "byte"])
+    args = p.parse_args(argv)
+
+    text = read_text(args.input, URL, os.path.join(args.out_dir, "input.txt"))
+    encode, _, name = get_tokenizer(args.tokenizer)
+    tokens = encode(text)
+    print(f"[prepare] tokenized {len(text):,} chars -> {len(tokens):,} "
+          f"tokens ({name})")
+    n = len(tokens)
+    split = int(n * 0.9)  # reference: first 90% train (prepare.py:21-23)
+    write_bin(tokens[:split], os.path.join(args.out_dir, "train.bin"))
+    write_bin(tokens[split:], os.path.join(args.out_dir, "val.bin"))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,102 @@
+"""Prepare TinyStories: HF `roneneldan/TinyStories` → parallel GPT-2 BPE
+tokenize (+ EOT append per story) → 1% val split (seed 1729) → uint16
+train.bin/val.bin.
+
+Reference parity (`data/tinystories/prepare.py:13-52`): same dataset, same
+1% split with the same seed, same EOT-50256 story delimiter, same parallel
+`.map` tokenization, same raw-uint16 output. Additions: `--input` treats a
+local text file (one story per blank-line-separated block) as the corpus
+for air-gapped runs, `--limit` for smoke tests.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+from distributed_pytorch_tpu.data.prepare import get_tokenizer, write_bin
+
+
+def _stories_from_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        blocks = f.read().split("\n\n")
+    return [b.strip() for b in blocks if b.strip()]
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="Prepare TinyStories .bins")
+    p.add_argument("--out_dir", default="data/tinystories")
+    p.add_argument("--input", default=None,
+                   help="local corpus file (blank-line-separated stories); "
+                        "skips the HF download")
+    p.add_argument("--tokenizer", default="auto",
+                   choices=["auto", "gpt2", "byte"])
+    p.add_argument("--limit", type=int, default=0,
+                   help="use only the first N stories (smoke tests)")
+    p.add_argument("--num_proc", type=int,
+                   default=max((os.cpu_count() or 2) // 2, 1))
+    args = p.parse_args(argv)
+
+    encode, eot, name = get_tokenizer(args.tokenizer)
+
+    if args.input:
+        stories = _stories_from_file(args.input)
+        if args.limit:
+            stories = stories[:args.limit]
+        rng = np.random.default_rng(1729)  # reference split seed
+        idx = rng.permutation(len(stories))
+        n_val = max(len(stories) // 100, 1)  # 1% val (reference :22-23)
+        val_ids = set(idx[:n_val].tolist())
+        splits = {
+            "train": [s for i, s in enumerate(stories) if i not in val_ids],
+            "val": [s for i, s in enumerate(stories) if i in val_ids],
+        }
+        for split, items in splits.items():
+            toks: list[int] = []
+            for s in items:
+                toks.extend(encode(s))
+                toks.append(eot)
+            write_bin(toks, os.path.join(args.out_dir, f"{split}.bin"))
+        print(f"[prepare] {len(splits['train'])} train / "
+              f"{len(splits['val'])} val stories ({name})")
+        return
+
+    # HF path (reference data/tinystories/prepare.py:13-52)
+    from datasets import load_dataset
+    ds = load_dataset("roneneldan/TinyStories", num_proc=args.num_proc)
+    full = ds["train"]
+    if args.limit:
+        full = full.select(range(args.limit))
+    split_ds = full.train_test_split(test_size=0.01, seed=1729,
+                                     shuffle=True)
+    named = {"train": split_ds["train"], "val": split_ds["test"]}
+
+    def tokenize(example):
+        ids = encode(example["text"])
+        ids.append(eot)  # reference appends EOT per story (:36)
+        return {"ids": ids, "len": len(ids)}
+
+    for split, dset in named.items():
+        tokenized = dset.map(tokenize, remove_columns=["text"],
+                             num_proc=args.num_proc,
+                             desc=f"tokenizing {split}")
+        total = int(np.sum(tokenized["len"], dtype=np.int64))
+        # stream Arrow batches into a memmap of the output file — the full
+        # ids column as Python lists would be tens of GB for the real
+        # dataset (nanoGPT-style batched write)
+        path = os.path.join(args.out_dir, f"{split}.bin")
+        os.makedirs(args.out_dir or ".", exist_ok=True)
+        out = np.memmap(path, dtype=np.uint16, mode="w+", shape=(total,))
+        pos = 0
+        for batch in tokenized.with_format("numpy").iter(batch_size=1024):
+            flat = np.concatenate(list(batch["ids"])).astype(np.uint16)
+            out[pos:pos + flat.size] = flat
+            pos += flat.size
+        out.flush()
+        print(f"[prepare] wrote {path}: {total:,} tokens")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,266 @@
+// Native token-batch sampler: the C++ runtime component of the data
+// pipeline (the reference delegates all native work to PyTorch internals —
+// SURVEY.md §2 native-code note; this framework ships its own).
+//
+// Responsibilities:
+//   * mmap a raw uint16 token file (zero-copy page-cache reads, the
+//     np.memmap equivalent of reference single-gpu/train.py:219);
+//   * counter-based Philox4x32-10 offset generation keyed on
+//     (seed, step, row) — any process can materialize any subset of the
+//     global batch deterministically (resharding-stable, resumable). The
+//     Python fallback (data/native.py philox_offsets) implements the SAME
+//     function; the test suite asserts bit-identical streams;
+//   * gather (x, y) = tokens[off : off+T], tokens[off+1 : off+T+1] as
+//     int32 into caller-owned buffers, parallelized over rows;
+//   * a background prefetch thread that pre-gathers step+1 into an
+//     internal double buffer while the accelerator runs step (the native
+//     analogue of the reference's pinned-memory async H2D prefetch,
+//     single-gpu/train.py:248-250).
+//
+// C API only (ctypes-friendly): no C++ types cross the boundary.
+
+#include <algorithm>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Philox4x32-10 (Salmon et al. 2011), counter-based stateless RNG.
+// ---------------------------------------------------------------------------
+
+constexpr uint32_t kPhiloxM0 = 0xD2511F53u;
+constexpr uint32_t kPhiloxM1 = 0xCD9E8D57u;
+constexpr uint32_t kPhiloxW0 = 0x9E3779B9u;
+constexpr uint32_t kPhiloxW1 = 0xBB67AE85u;
+
+struct Ctr {
+  uint32_t v[4];
+};
+
+inline Ctr philox4x32_10(Ctr ctr, uint32_t k0, uint32_t k1) {
+  for (int round = 0; round < 10; ++round) {
+    uint64_t p0 = static_cast<uint64_t>(kPhiloxM0) * ctr.v[0];
+    uint64_t p1 = static_cast<uint64_t>(kPhiloxM1) * ctr.v[2];
+    uint32_t hi0 = static_cast<uint32_t>(p0 >> 32);
+    uint32_t lo0 = static_cast<uint32_t>(p0);
+    uint32_t hi1 = static_cast<uint32_t>(p1 >> 32);
+    uint32_t lo1 = static_cast<uint32_t>(p1);
+    Ctr next;
+    next.v[0] = hi1 ^ ctr.v[1] ^ k0;
+    next.v[1] = lo1;
+    next.v[2] = hi0 ^ ctr.v[3] ^ k1;
+    next.v[3] = lo0;
+    ctr = next;
+    k0 += kPhiloxW0;
+    k1 += kPhiloxW1;
+  }
+  return ctr;
+}
+
+// offset for (seed, step, row) in [0, hi): counter (row, step, 0, 0),
+// key = (seed lo32, seed hi32); u64 from lanes 0,1; modulo reduction.
+inline uint64_t sample_offset(uint64_t seed, uint64_t step, uint32_t row,
+                              uint64_t hi) {
+  Ctr c{{row, static_cast<uint32_t>(step),
+         static_cast<uint32_t>(step >> 32), 0u}};
+  Ctr r = philox4x32_10(c, static_cast<uint32_t>(seed),
+                        static_cast<uint32_t>(seed >> 32));
+  uint64_t u = (static_cast<uint64_t>(r.v[1]) << 32) | r.v[0];
+  return u % hi;
+}
+
+// ---------------------------------------------------------------------------
+// Loader
+// ---------------------------------------------------------------------------
+
+struct Loader {
+  int fd = -1;
+  const uint16_t* tokens = nullptr;
+  uint64_t n_tokens = 0;
+
+  // prefetch state
+  std::thread worker;
+  std::mutex mu;
+  std::condition_variable cv;
+  bool has_request = false;     // worker should run
+  bool has_result = false;      // buffers hold a completed prefetch
+  bool shutdown = false;
+  uint64_t pf_seed = 0, pf_step = 0;
+  uint32_t pf_rows = 0, pf_T = 0;
+  std::vector<int32_t> pf_x, pf_y;
+
+  ~Loader() { stop_worker(); unmap(); }
+
+  void unmap() {
+    if (tokens) munmap(const_cast<uint16_t*>(tokens),
+                       n_tokens * sizeof(uint16_t));
+    if (fd >= 0) close(fd);
+    tokens = nullptr;
+    fd = -1;
+  }
+
+  void stop_worker() {
+    if (worker.joinable()) {
+      {
+        std::lock_guard<std::mutex> g(mu);
+        shutdown = true;
+      }
+      cv.notify_all();
+      worker.join();
+    }
+  }
+
+  void gather(uint64_t seed, uint64_t step, uint32_t n_rows, uint32_t T,
+              int32_t* x, int32_t* y) const {
+    const uint64_t hi = n_tokens - T - 1;
+    const unsigned hw = std::thread::hardware_concurrency();
+    const uint32_t n_threads =
+        std::max(1u, std::min(hw ? hw / 2 : 1u, n_rows));
+    auto work = [&](uint32_t lo_row, uint32_t hi_row) {
+      for (uint32_t r = lo_row; r < hi_row; ++r) {
+        const uint64_t off = sample_offset(seed, step, r, hi);
+        const uint16_t* src = tokens + off;
+        int32_t* xr = x + static_cast<uint64_t>(r) * T;
+        int32_t* yr = y + static_cast<uint64_t>(r) * T;
+        for (uint32_t t = 0; t < T; ++t) {
+          xr[t] = src[t];
+          yr[t] = src[t + 1];
+        }
+      }
+    };
+    if (n_threads == 1) {
+      work(0, n_rows);
+      return;
+    }
+    std::vector<std::thread> ts;
+    const uint32_t chunk = (n_rows + n_threads - 1) / n_threads;
+    for (uint32_t i = 0; i < n_threads; ++i) {
+      uint32_t lo_row = i * chunk;
+      uint32_t hi_row = std::min(n_rows, lo_row + chunk);
+      if (lo_row >= hi_row) break;
+      ts.emplace_back(work, lo_row, hi_row);
+    }
+    for (auto& t : ts) t.join();
+  }
+
+  void worker_loop() {
+    std::unique_lock<std::mutex> lk(mu);
+    while (true) {
+      cv.wait(lk, [&] { return has_request || shutdown; });
+      if (shutdown) return;
+      uint64_t seed = pf_seed, step = pf_step;
+      uint32_t rows = pf_rows, T = pf_T;
+      pf_x.resize(static_cast<size_t>(rows) * T);
+      pf_y.resize(static_cast<size_t>(rows) * T);
+      lk.unlock();
+      gather(seed, step, rows, T, pf_x.data(), pf_y.data());
+      lk.lock();
+      has_request = false;
+      has_result = true;
+      cv.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dl_open(const char* path) {
+  auto* L = new Loader();
+  L->fd = open(path, O_RDONLY);
+  if (L->fd < 0) {
+    delete L;
+    return nullptr;
+  }
+  struct stat st;
+  if (fstat(L->fd, &st) != 0 || st.st_size < 4) {
+    delete L;
+    return nullptr;
+  }
+  L->n_tokens = static_cast<uint64_t>(st.st_size) / sizeof(uint16_t);
+  void* m = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, L->fd, 0);
+  if (m == MAP_FAILED) {
+    delete L;
+    return nullptr;
+  }
+  madvise(m, st.st_size, MADV_RANDOM);  // uniform-random batch offsets
+  L->tokens = static_cast<const uint16_t*>(m);
+  L->worker = std::thread(&Loader::worker_loop, L);
+  return L;
+}
+
+void dl_close(void* h) { delete static_cast<Loader*>(h); }
+
+uint64_t dl_num_tokens(void* h) {
+  return static_cast<Loader*>(h)->n_tokens;
+}
+
+// Fill x/y (n_rows * T int32 each) for (seed, step). If the prefetch
+// buffer holds exactly this request, memcpy it; otherwise gather now.
+// Then kick off a prefetch of step+1 in the background.
+int dl_sample(void* h, uint64_t seed, uint64_t step, uint32_t n_rows,
+              uint32_t T, int32_t* x, int32_t* y) {
+  auto* L = static_cast<Loader*>(h);
+  if (L->n_tokens < static_cast<uint64_t>(T) + 2) return -1;
+  const size_t n = static_cast<size_t>(n_rows) * T;
+
+  bool served = false;
+  {
+    std::unique_lock<std::mutex> lk(L->mu);
+    // wait for any in-flight prefetch so buffers are stable
+    L->cv.wait(lk, [&] { return !L->has_request; });
+    if (L->has_result && L->pf_seed == seed && L->pf_step == step &&
+        L->pf_rows == n_rows && L->pf_T == T) {
+      std::memcpy(x, L->pf_x.data(), n * sizeof(int32_t));
+      std::memcpy(y, L->pf_y.data(), n * sizeof(int32_t));
+      served = true;
+    }
+  }
+  if (!served) L->gather(seed, step, n_rows, T, x, y);
+
+  {
+    std::lock_guard<std::mutex> g(L->mu);
+    L->pf_seed = seed;
+    L->pf_step = step + 1;
+    L->pf_rows = n_rows;
+    L->pf_T = T;
+    L->has_result = false;
+    L->has_request = true;
+  }
+  L->cv.notify_all();
+  return 0;
+}
+
+// Synchronous single-shot sampling of an arbitrary row subset (multi-host
+// shard materialization): rows[] are global batch-row ids.
+int dl_sample_rows(void* h, uint64_t seed, uint64_t step,
+                   const uint32_t* rows, uint32_t n_rows, uint32_t T,
+                   int32_t* x, int32_t* y) {
+  auto* L = static_cast<Loader*>(h);
+  if (L->n_tokens < static_cast<uint64_t>(T) + 2) return -1;
+  const uint64_t hi = L->n_tokens - T - 1;
+  for (uint32_t i = 0; i < n_rows; ++i) {
+    const uint64_t off = sample_offset(seed, step, rows[i], hi);
+    const uint16_t* src = L->tokens + off;
+    int32_t* xr = x + static_cast<uint64_t>(i) * T;
+    int32_t* yr = y + static_cast<uint64_t>(i) * T;
+    for (uint32_t t = 0; t < T; ++t) {
+      xr[t] = src[t];
+      yr[t] = src[t + 1];
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

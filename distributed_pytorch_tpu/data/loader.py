@@ -48,7 +48,7 @@ class DataLoader:
 
     def __init__(self, file_path: str, batch_size: int, block_size: int, *,
                  grad_accum: int = 1, seed: int = 1729,
-                 mesh=None, pspec=None):
+                 mesh=None, pspec=None, backend: str = "auto"):
         self.tokens = np.memmap(file_path, dtype=np.uint16, mode="r")
         assert len(self.tokens) > block_size + 1, (
             f"dataset {file_path} too small: {len(self.tokens)} tokens "
@@ -60,14 +60,35 @@ class DataLoader:
         self.pspec = pspec
         self._sharding = (NamedSharding(mesh, pspec)
                          if mesh is not None and pspec is not None else None)
+        # native C++ sampler (csrc/sampler.cpp: mmap + threaded gather +
+        # background prefetch); the numpy path computes the SAME
+        # Philox4x32-10 stream, so the backends are interchangeable
+        assert backend in ("auto", "native", "numpy")
+        self._native = None
+        if backend in ("auto", "native"):
+            from distributed_pytorch_tpu.data import native
+            try:
+                self._native = native.NativeSampler(file_path)
+            except OSError:
+                if backend == "native":
+                    raise
+        self.backend = "native" if self._native is not None else "numpy"
 
     def _sample(self, step: int, rows: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """Gather (len(rows), T) x/y pairs for global batch-row ids `rows` at
-        `step`. Counter-based (Philox) keyed on (seed, step): any process can
-        materialize any subset of the global batch deterministically."""
-        rng = np.random.Generator(np.random.Philox(key=self.seed + (step << 20)))
+        `step`. Counter-based (Philox4x32-10) keyed on (seed, step, row): any
+        process can materialize any subset of the global batch
+        deterministically."""
+        rows = np.asarray(rows)
+        if self._native is not None:
+            full = len(rows) == self.A * self.B and \
+                np.array_equal(rows, np.arange(self.A * self.B))
+            if full:  # contiguous global batch: prefetched path
+                return self._native.sample(self.seed, step, len(rows), self.T)
+            return self._native.sample_rows(self.seed, step, rows, self.T)
+        from distributed_pytorch_tpu.data.native import philox_offsets
         hi = len(self.tokens) - self.T - 1
-        offsets = rng.integers(0, hi, size=self.A * self.B)[rows]
+        offsets = philox_offsets(self.seed, step, rows, hi)
         idx = offsets[:, None] + np.arange(self.T + 1)[None, :]
         seqs = self.tokens[idx].astype(np.int32)
         return seqs[:, :-1], seqs[:, 1:]

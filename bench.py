@@ -7,30 +7,87 @@ star is >=50% MFU on the FSDP config (BASELINE.json), so `vs_baseline` is
 measured MFU / 0.50 (1.0 == target met). On hardware without a known peak
 FLOPs figure (CPU smoke runs), falls back to tokens/sec with
 vs_baseline=0.
+
+Crash-safety contract (round-1 lesson, BENCH_r01.json): the TPU backend
+behind the image's `axon` tunnel can fail to initialize — or HANG
+`jax.devices()` forever when half-up — and the sitecustomize registration
+overrides JAX_PLATFORMS, so no in-process guard is sufficient. Design:
+a thin parent (this file, no jax import) runs the measurement in a WORKER
+SUBPROCESS with a hard timeout; on TPU failure/timeout it reruns the worker
+pinned to CPU (via jax.config.update, which *does* override axon's
+jax_platforms='axon,cpu'); if everything burns, it still prints an error
+JSON line. The driver always gets its line.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
+import time
+
+_PROBE = (
+    "import jax; assert jax.default_backend() == 'tpu';"
+    "import jax.numpy as jnp;"
+    "x = jnp.ones((256, 256), jnp.bfloat16);"
+    "(x @ x).block_until_ready()"
+)
 
 
-def main() -> None:
+def tpu_available(attempts: int = 2, timeout_s: int = 240) -> bool:
+    """Probe TPU init + one compiled matmul in a throwaway subprocess so a
+    wedged tunnel can't take the parent down. First TPU compile can take
+    ~20-40s; the timeout is generous."""
+    for i in range(attempts):
+        try:
+            r = subprocess.run([sys.executable, "-c", _PROBE],
+                               capture_output=True, timeout=timeout_s)
+            if r.returncode == 0:
+                return True
+            sys.stderr.write(f"[bench] TPU probe {i + 1}/{attempts} failed "
+                             f"(rc={r.returncode}): "
+                             f"{r.stderr.decode()[-300:]}\n")
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(f"[bench] TPU probe {i + 1}/{attempts} timed "
+                             f"out after {timeout_s}s\n")
+        if i + 1 < attempts:
+            time.sleep(10)
+    return False
+
+
+def run_bench(platform: str) -> dict:
+    """Worker-side measurement. `platform` is 'tpu' or 'cpu'."""
     import jax
 
+    if platform == "cpu":
+        # The image's sitecustomize imports jax and pins
+        # jax_platforms='axon,cpu' at interpreter start, so the env var is
+        # powerless — live config update is the only working CPU pin
+        # (.claude/skills/verify/SKILL.md).
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass  # backend already initialized as cpu
+
     from distributed_pytorch_tpu.config import LLMConfig, TrainConfig
-    from distributed_pytorch_tpu.train import metrics as M
     from distributed_pytorch_tpu.train.loop import train
 
-    on_tpu = jax.default_backend() == "tpu"
     n_dev = len(jax.devices())
 
-    if on_tpu:
+    if platform == "tpu":
+        # The probe passing doesn't guarantee THIS process gets the TPU:
+        # jax_platforms='axon,cpu' falls through to cpu without error if the
+        # tunnel drops in between. Fail fast into the parent's CPU fallback
+        # rather than grinding the 124M config on a CPU.
+        assert jax.default_backend() == "tpu", \
+            f"TPU probe passed but worker got {jax.default_backend()!r}"
         model_cfg = LLMConfig(
             vocab_size=50304, block_size=1024, n_embd=768, n_head=12,
             n_kv_heads=12, attn="mha", n_layer=12, up_dim=3072,
             non_linearity="swiglu", pos_emb="rope")
-        batch, iters = 8, 12
+        batch = int(os.environ.get("BENCH_BATCH", "16"))
+        iters = int(os.environ.get("BENCH_ITERS", "12"))
     else:  # CPU smoke: tiny proxy so the harness still gets a line
         model_cfg = LLMConfig(
             vocab_size=1024, block_size=256, n_embd=256, n_head=8,
@@ -44,24 +101,68 @@ def main() -> None:
         total_batch_size=batch * model_cfg.block_size,
         batch_size=max(1, batch // n_dev),
         max_iters=iters, parallelism=recipe,
-        log_interval=10 ** 9, compute_dtype="bfloat16")
+        log_interval=1, eval=False, save_model=False, save_stats=False,
+        compute_dtype="bfloat16")
 
     stats = train(model_cfg, train_cfg, log=lambda s: print(s, file=sys.stderr))
 
     tps_chip = stats["median_tokens_per_sec"] / n_dev
     mfu = stats.get("median_mfu")
     if mfu is not None:
-        out = {"metric": "mfu_gpt124m", "value": round(mfu, 4),
-               "unit": "fraction_of_peak",
-               "vs_baseline": round(mfu / 0.50, 4),
-               "tokens_per_sec_per_chip": round(tps_chip, 1),
-               "n_chips": n_dev, "recipe": recipe,
-               "device": jax.devices()[0].device_kind}
+        return {"metric": "mfu_gpt124m", "value": round(mfu, 4),
+                "unit": "fraction_of_peak",
+                "vs_baseline": round(mfu / 0.50, 4),
+                "tokens_per_sec_per_chip": round(tps_chip, 1),
+                "n_chips": n_dev, "recipe": recipe,
+                "device": jax.devices()[0].device_kind}
+    return {"metric": "tokens_per_sec_per_chip", "value": round(tps_chip, 1),
+            "unit": "tok/s/chip", "vs_baseline": 0,
+            "n_chips": n_dev, "recipe": recipe,
+            "device": jax.devices()[0].device_kind}
+
+
+def _worker_main(platform: str) -> None:
+    print(json.dumps(run_bench(platform)))
+
+
+def _spawn_worker(platform: str, timeout_s: int) -> dict | None:
+    """Run the worker subprocess; return its parsed JSON line or None."""
+    try:
+        r = subprocess.run([sys.executable, __file__, "--worker", platform],
+                           capture_output=True, timeout=timeout_s)
+        sys.stderr.write(r.stderr.decode()[-4000:])
+        if r.returncode == 0 and r.stdout:
+            for line in reversed(r.stdout.decode().strip().splitlines()):
+                try:
+                    return json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+        sys.stderr.write(f"[bench] {platform} worker rc={r.returncode}, "
+                         f"no JSON line\n")
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(f"[bench] {platform} worker timed out "
+                         f"({timeout_s}s)\n")
+    except Exception as e:  # noqa: BLE001
+        sys.stderr.write(f"[bench] {platform} worker error: {e!r}\n")
+    return None
+
+
+def main() -> None:
+    if len(sys.argv) > 2 and sys.argv[1] == "--worker":
+        _worker_main(sys.argv[2])
+        return
+
+    out = None
+    if tpu_available():
+        out = _spawn_worker("tpu", timeout_s=1800)
     else:
-        out = {"metric": "tokens_per_sec_per_chip", "value": round(tps_chip, 1),
-               "unit": "tok/s/chip", "vs_baseline": 0,
-               "n_chips": n_dev, "recipe": recipe,
-               "device": jax.devices()[0].device_kind}
+        sys.stderr.write("[bench] TPU unavailable -> CPU fallback\n")
+    if out is None:
+        out = _spawn_worker("cpu", timeout_s=1200)
+    if out is None:
+        out = {"metric": "bench_error", "value": 0, "unit": "error",
+               "vs_baseline": 0,
+               "error": "all bench workers failed; see stderr"}
     print(json.dumps(out))
 
 

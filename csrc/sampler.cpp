@@ -263,4 +263,13 @@ int dl_sample_rows(void* h, uint64_t seed, uint64_t step,
   return 0;
 }
 
+// Raw Philox offsets for a row subset — exported so the Python test suite
+// can assert bit-identity against the NumPy fallback directly (not just via
+// gathered batches). No Loader handle needed.
+void dl_sample_offsets(uint64_t seed, uint64_t step, const uint32_t* rows,
+                       uint32_t n_rows, uint64_t hi, int64_t* out) {
+  for (uint32_t i = 0; i < n_rows; ++i)
+    out[i] = static_cast<int64_t>(sample_offset(seed, step, rows[i], hi));
+}
+
 }  // extern "C"

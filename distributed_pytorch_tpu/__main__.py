@@ -10,12 +10,21 @@ Flag surface mirrors the reference's ~33 argparse flags
 """
 
 from distributed_pytorch_tpu.config import build_parser, configs_from_args
-from distributed_pytorch_tpu.train.loop import train
 
 
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
     model_cfg, train_cfg = configs_from_args(args)
+
+    if train_cfg.platform != "auto":
+        # Pin the backend BEFORE any jax device op. Env vars are not enough
+        # on images whose sitecustomize imports jax at interpreter start
+        # (config already initialized); the live config update still works
+        # because backend clients are created lazily.
+        import jax
+        jax.config.update("jax_platforms", train_cfg.platform)
+
+    from distributed_pytorch_tpu.train.loop import train
     train(model_cfg, train_cfg)
 
 

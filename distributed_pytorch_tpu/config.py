@@ -67,6 +67,13 @@ class LLMConfig:
     aux_free: bool = True   # aux-loss-free balancing (bias-based)
     alpha: float = 1e-4     # complementary seq-wise aux loss coeff
     gamma: float = 1e-3     # bias update speed
+    # routed-expert dispatch: 'dense' evaluates every routed expert on every
+    # token (semantics oracle, no token dropping; fine for few experts);
+    # 'scatter' is the capacity-bounded sort-based dispatch (EP-shardable,
+    # O(active) FLOPs — the reference's O(active) Python loop equivalent,
+    # single-gpu/model.py:489-506, made static-shape for XLA)
+    moe_impl: str = "dense"
+    capacity_factor: float = 2.0  # scatter: per-expert slots = cf * N*k/E
 
     # attention
     attn: str = "gqa"  # Literal['mha','mqa','gqa','mla']
@@ -109,6 +116,9 @@ class LLMConfig:
             assert self.n_exp > self.n_shared
             assert self.n_act <= self.n_exp, \
                 "n_act (which includes shared experts) cannot exceed n_exp"
+        assert self.moe_impl in ("dense", "scatter"), \
+            f"unknown moe_impl {self.moe_impl!r}"
+        assert self.capacity_factor > 0
 
     @property
     def head_size(self) -> int:
@@ -141,6 +151,9 @@ class TrainConfig:
     weight_decay: float = 0.1
     grad_clip: float = 1.0
     save_model: bool = False
+    save_stats: bool = True          # persist run stats as <ckpt>/stats.json
+                                     # (reference `<name>_stats.pt`,
+                                     # single-gpu/train.py:363-372)
     file_name: str = "llm_model"
     act_recomp: bool = False
     seed: int = 1729
@@ -148,6 +161,9 @@ class TrainConfig:
     # --- TPU-native fields (no reference equivalent; replace the reference's
     # per-script hardcoding of AMP dtype and torchrun world topology) ---
     parallelism: str = "single"      # see PARALLELISM_RECIPES
+    platform: str = "auto"           # auto | tpu | cpu — pin the JAX
+                                     # backend (cpu = tunnel-independent
+                                     # smoke runs; see scripts/train.sh)
     dp_size: int = -1                # -1: infer from device count
     tp_size: int = 1                 # model axis size (tp / fsdp_tp)
     ep_size: int = 1                 # expert axis size (ep)
@@ -167,12 +183,13 @@ class TrainConfig:
     def __post_init__(self):
         assert self.parallelism in PARALLELISM_RECIPES, \
             f"unknown parallelism recipe {self.parallelism!r}"
-        assert self.moe_impl in ("dense",), \
-            "moe_impl 'scatter' (capacity-bounded sort dispatch) is planned " \
-            "but not yet implemented; use 'dense'"
+        assert self.moe_impl in ("dense", "scatter"), \
+            f"unknown moe_impl {self.moe_impl!r}"
         assert self.attn_impl in ("auto", "xla", "pallas", "naive", "ring",
                                   "ulysses"), \
             f"unknown attn_impl {self.attn_impl!r}"
+        assert self.platform in ("auto", "tpu", "cpu"), \
+            f"unknown platform {self.platform!r}"
 
 
 # ---------------------------------------------------------------------------
@@ -184,7 +201,7 @@ _BOOL_FLAGS = {
     # reference store_true flags (single-gpu/train.py:176-180)
     "moe", "aux_free", "eval", "save_model", "act_recomp",
     # new
-    "resume", "profile",
+    "resume", "profile", "save_stats",
 }
 
 

@@ -3,7 +3,10 @@ bit-identical vectorized NumPy fallback.
 
 Build model: the shared library is compiled on demand with g++ (no
 pybind11 in this image; plain `extern "C"` + ctypes) and cached next to
-the source keyed by source mtime. Environments without a toolchain fall
+the source, keyed by a content hash of the source plus the compiler
+version — never by mtime, so a fresh clone always compiles from the
+committed source and an edited sampler.cpp always rebuilds. The build
+directory is untracked (.gitignore). Environments without a toolchain fall
 back to `philox_offsets` / pure-numpy gathers transparently — the
 DataLoader behaves identically either way because both implementations
 compute the same Philox4x32-10 stream (asserted by tests/test_native.py).
@@ -12,6 +15,8 @@ compute the same Philox4x32-10 stream (asserted by tests/test_native.py).
 from __future__ import annotations
 
 import ctypes
+import functools
+import hashlib
 import os
 import subprocess
 import threading
@@ -21,7 +26,25 @@ import numpy as np
 
 _SRC = os.path.join(os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__)))), "csrc", "sampler.cpp")
-_LIB_PATH = os.path.join(os.path.dirname(_SRC), "build", "libsampler.so")
+_BUILD_DIR = os.path.join(os.path.dirname(_SRC), "build")
+
+
+@functools.lru_cache(maxsize=1)
+def _lib_path() -> Optional[str]:
+    """Cache path keyed on sha256(source) + g++ version: a stale or
+    unverifiable committed binary can never shadow the committed source."""
+    if not os.path.exists(_SRC):
+        return None
+    h = hashlib.sha256()
+    with open(_SRC, "rb") as f:
+        h.update(f.read())
+    try:
+        ver = subprocess.run(["g++", "--version"], capture_output=True,
+                             timeout=30).stdout.split(b"\n", 1)[0]
+    except Exception:
+        ver = b"no-gxx"
+    h.update(ver)
+    return os.path.join(_BUILD_DIR, f"libsampler-{h.hexdigest()[:16]}.so")
 
 _lib_lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -59,20 +82,20 @@ def philox_offsets(seed: int, step: int, rows: np.ndarray,
 
 
 def _build_lib() -> Optional[str]:
-    """Compile csrc/sampler.cpp -> build/libsampler.so if stale/missing."""
-    if not os.path.exists(_SRC):
+    """Compile csrc/sampler.cpp -> build/libsampler-<hash>.so if missing."""
+    path = _lib_path()
+    if path is None:
         return None
-    os.makedirs(os.path.dirname(_LIB_PATH), exist_ok=True)
-    if (os.path.exists(_LIB_PATH)
-            and os.path.getmtime(_LIB_PATH) >= os.path.getmtime(_SRC)):
-        return _LIB_PATH
-    tmp = _LIB_PATH + f".tmp{os.getpid()}"
+    if os.path.exists(path):
+        return path
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    tmp = path + f".tmp{os.getpid()}"
     cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
            _SRC, "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _LIB_PATH)
-        return _LIB_PATH
+        os.replace(tmp, path)
+        return path
     except Exception:
         if os.path.exists(tmp):
             os.unlink(tmp)
@@ -109,12 +132,30 @@ def _load_lib() -> Optional[ctypes.CDLL]:
                                        ctypes.c_uint64, u32p,
                                        ctypes.c_uint32, ctypes.c_uint32,
                                        i32p, i32p]
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        lib.dl_sample_offsets.restype = None
+        lib.dl_sample_offsets.argtypes = [ctypes.c_uint64, ctypes.c_uint64,
+                                          u32p, ctypes.c_uint32,
+                                          ctypes.c_uint64, i64p]
         _lib = lib
         return _lib
 
 
 def native_available() -> bool:
     return _load_lib() is not None
+
+
+def native_offsets(seed: int, step: int, rows: np.ndarray,
+                   hi: int) -> np.ndarray:
+    """The C++ sample_offset() stream for `rows` — the native counterpart of
+    `philox_offsets`, exported for direct bit-identity testing."""
+    lib = _load_lib()
+    if lib is None:
+        raise OSError("native sampler library unavailable")
+    rows = np.ascontiguousarray(rows, np.uint32)
+    out = np.empty(len(rows), np.int64)
+    lib.dl_sample_offsets(seed, step, rows, len(rows), hi, out)
+    return out
 
 
 class NativeSampler:

@@ -24,13 +24,22 @@ TPU-first design (SURVEY §7 hard part (a)):
   leaf per projection, shardable over an 'expert' mesh axis for expert
   parallelism (capability absent from the reference, whose dispatch is a
   data-dependent Python loop over experts, model.py:489-506).
-* Dispatch is static-shape. 'dense' mode evaluates every routed expert on
-  every token and combines with a (tokens, n_routed) gate matrix that is
-  zero outside the top-k — bitwise-equal semantics to the reference loop
-  (no capacity limit, no token dropping) at n_routed/k extra FLOPs; good
-  for small expert counts and as the semantics oracle. A capacity-bounded
-  sort-based 'scatter' mode for large expert counts is planned
-  (TrainConfig validates moe_impl until it lands).
+* Dispatch is static-shape, two modes (LLMConfig.moe_impl):
+  - 'dense' evaluates every routed expert on every token and combines with
+    a (tokens, n_routed) gate matrix that is zero outside the top-k —
+    bitwise-equal semantics to the reference loop (no capacity limit, no
+    token dropping) at n_routed/k extra FLOPs; good for small expert
+    counts and as the semantics oracle.
+  - 'scatter' is the capacity-bounded sort-based dispatch: assignments are
+    stable-sorted by expert, each expert takes its first
+    `capacity = ceil(capacity_factor * N*k/E)` tokens into an (E, cap, C)
+    buffer (later tokens are DROPPED, GShard-style position priority),
+    expert FFNs run batched over the leading expert axis, and results
+    scatter-add back weighted by their gates. O(active) FLOPs like the
+    reference's Python loop (model.py:489-506) but static-shape for XLA;
+    the (E, cap, C) buffers carry a 'expert'-axis sharding constraint so
+    under the ep recipe GSPMD turns dispatch/return into all-to-alls over
+    the expert mesh axis.
 * The aux-free bias is cross-batch mutable state; it lives in the 'moe_state'
   variable collection, carried in the train state. Under pjit the batch is
   global, so load statistics (and hence the bias update) are computed over
@@ -41,11 +50,13 @@ TPU-first design (SURVEY §7 hard part (a)):
 
 from __future__ import annotations
 
+import math
 from typing import Callable
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from distributed_pytorch_tpu.config import LLMConfig
 
@@ -107,6 +118,71 @@ class MLP(nn.Module):
         y = mlp_apply(x, w_fc.astype(x.dtype), w_proj.astype(x.dtype),
                       cfg.non_linearity)
         return nn.Dropout(cfg.dropout, deterministic=deterministic)(y)
+
+
+def _expert_constraint(t: jnp.ndarray) -> jnp.ndarray:
+    """Pin a leading-expert-axis tensor to the 'expert' mesh axis when the
+    ambient mesh has one — this is what makes GSPMD lower the scatter
+    dispatch's gather/return as all-to-alls over ICI instead of gathering
+    all tokens onto every expert shard."""
+    from distributed_pytorch_tpu.parallel import context
+    mesh = context.get_mesh()
+    if mesh is None or "expert" not in mesh.axis_names \
+            or mesh.shape["expert"] <= 1:
+        return t
+    spec = P(*(["expert"] + [None] * (t.ndim - 1)))
+    return jax.lax.with_sharding_constraint(t, NamedSharding(mesh, spec))
+
+
+def scatter_dispatch(x_flat: jnp.ndarray, topk_idx: jnp.ndarray,
+                     topk_gates: jnp.ndarray, experts_fc: jnp.ndarray,
+                     experts_proj: jnp.ndarray, *, non_linearity: str,
+                     capacity: int) -> jnp.ndarray:
+    """Capacity-bounded sort-based routed-expert dispatch.
+
+    x_flat (N, C); topk_idx/topk_gates (N, k) over E routed experts whose
+    stacked kernels are experts_fc (E, C, fc_out) / experts_proj (E, up, C).
+    Returns (N, C). Tokens beyond an expert's `capacity` are dropped
+    (earlier tokens win — GShard position priority); with capacity >=
+    max expert load this is numerically the reference loop
+    (single-gpu/model.py:489-506) up to summation order.
+    """
+    N, k = topk_idx.shape
+    E = experts_fc.shape[0]
+    dt = x_flat.dtype
+
+    flat_e = topk_idx.reshape(-1)                          # (N*k,)
+    flat_g = topk_gates.reshape(-1).astype(jnp.float32)
+    flat_t = jnp.arange(N * k, dtype=jnp.int32) // k       # owning token
+
+    order = jnp.argsort(flat_e, stable=True)               # group by expert
+    se, st, sg = flat_e[order], flat_t[order], flat_g[order]
+
+    counts = jnp.zeros((E,), jnp.int32).at[flat_e].add(1)
+    starts = jnp.cumsum(counts) - counts                   # segment offsets
+    pos = jnp.arange(N * k, dtype=jnp.int32) - starts[se]  # rank within expert
+    keep = pos < capacity
+
+    # slot in the flattened (E*capacity) buffer; dropped assignments all
+    # land in one overflow cell that is sliced away
+    slot = jnp.where(keep, se * capacity + pos, E * capacity)
+    buf_tok = jnp.zeros((E * capacity + 1,), jnp.int32).at[slot].set(st)
+    buf_gate = jnp.zeros((E * capacity + 1,), jnp.float32).at[slot].set(sg)
+    tok_grid = buf_tok[:-1].reshape(E, capacity)
+    gate_grid = buf_gate[:-1].reshape(E, capacity)
+    # unfilled slots keep token 0 with gate 0: computed then zeroed — wasted
+    # lanes, never wrong
+
+    xg = _expert_constraint(x_flat[tok_grid])              # (E, cap, C)
+
+    def one(wf, wp, xe):
+        return mlp_apply(xe, wf.astype(dt), wp.astype(dt), non_linearity)
+
+    y = jax.vmap(one)(experts_fc, experts_proj, xg)        # (E, cap, C)
+    y = _expert_constraint(y * gate_grid[..., None].astype(dt))
+
+    return jnp.zeros_like(x_flat).at[tok_grid.reshape(-1)].add(
+        y.reshape(E * capacity, -1))
 
 
 class MoE(nn.Module):
@@ -179,13 +255,22 @@ class MoE(nn.Module):
             pi = jax.nn.softmax(router_logits, axis=1).mean(axis=0)
             aux_loss = cfg.coeff * n_routed * jnp.sum(pi * fi)
 
-        # combine[t, e] = gate weight of expert e for token t (0 if unrouted)
-        combine = (one_hot * topk_gates[..., None]).sum(axis=1)  # (N, n_routed)
-
-        # ---------------- routed dispatch (dense; see module docstring) ----
-        all_routed = jax.vmap(one_expert)(
-            experts_fc[n_shared:], experts_proj[n_shared:])  # (E, N, C)
-        routed_out = jnp.einsum("enc,ne->nc", all_routed, combine.astype(dt))
+        # ---------------- routed dispatch (see module docstring) -----------
+        if cfg.moe_impl == "scatter":
+            capacity = max(k, math.ceil(
+                cfg.capacity_factor * n_tokens * k / n_routed))
+            routed_out = scatter_dispatch(
+                x_flat, topk_idx, topk_gates,
+                experts_fc[n_shared:], experts_proj[n_shared:],
+                non_linearity=cfg.non_linearity, capacity=capacity)
+        else:
+            # combine[t, e] = gate weight of expert e for token t (0 if
+            # unrouted)
+            combine = (one_hot * topk_gates[..., None]).sum(axis=1)  # (N, E)
+            all_routed = jax.vmap(one_expert)(
+                experts_fc[n_shared:], experts_proj[n_shared:])  # (E, N, C)
+            routed_out = jnp.einsum("enc,ne->nc", all_routed,
+                                    combine.astype(dt))
 
         y = (shared_out + routed_out).reshape(B, T, C)
         return y, aux_loss.astype(jnp.float32)

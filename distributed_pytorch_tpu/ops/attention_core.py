@@ -25,6 +25,7 @@ the reference's transpose dance to (B, nh, T, hs).
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Optional
 
 import jax
@@ -98,11 +99,20 @@ def sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     # BEFORE the first (tracing) call of their jitted function, as the
     # trainer's step builders do (train/step.py); a function first traced
     # without the mesh keeps its GSPMD full-gather path.
+    from distributed_pytorch_tpu.parallel import context
+    sp = context.seq_axis_size()
+    sp_live = sp > 1 and not context.in_sp_region()
+
+    if use_dropout and sp_live:
+        warnings.warn(
+            "attention dropout > 0 disables the sequence-parallel "
+            "ring/Ulysses path: every device falls back to full-sequence "
+            "O(T^2) attention, defeating the sp recipe's memory purpose. "
+            "Set dropout=0.0 (the default) for sp training.",
+            RuntimeWarning, stacklevel=2)
+
     if not use_dropout:
-        from distributed_pytorch_tpu.parallel import context
-        sp = context.seq_axis_size()
-        if sp > 1 and not context.in_sp_region() \
-                and impl in ("auto", "ring", "ulysses"):
+        if sp_live and impl in ("auto", "ring", "ulysses"):
             static_zero = isinstance(q_offset, int) and q_offset == 0
             mesh = context.get_mesh()
             dp = mesh.shape["data"]

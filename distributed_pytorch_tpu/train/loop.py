@@ -12,6 +12,7 @@ reference logs only ms/step + reserved GB, train.py:354-359).
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import time
 from typing import Any, Callable, Optional
@@ -34,15 +35,23 @@ def maybe_initialize_distributed() -> None:
     """Multi-host bring-up (SURVEY.md §2c multi-node gap): the reference is
     single-node only (`torchrun --standalone`, multi-gpu/ddp/train.sh:49).
     On TPU pods, launchers set JAX_COORDINATOR_ADDRESS etc.; initialize
-    exactly once, and only when a multi-process env is announced."""
-    if jax.process_count() > 1:
+    exactly once, and only when a multi-process env is announced.
+
+    Ordering matters (round-1 bug): any backend probe — even
+    `jax.process_count()` — initializes the local backend, after which
+    `jax.distributed.initialize()` is too late and N processes silently run
+    disconnected. So the env-var gate comes FIRST and the only pre-init
+    check is jax.distributed's own client state, which touches no backend."""
+    if not (os.environ.get("JAX_COORDINATOR_ADDRESS")
+            or os.environ.get("JAX_NUM_PROCESSES")):
+        return
+    from jax._src import distributed as _dist_state
+    if _dist_state.global_state.client is not None:
         return  # already initialized
-    if os.environ.get("JAX_COORDINATOR_ADDRESS") or \
-            os.environ.get("JAX_NUM_PROCESSES"):
-        try:
-            jax.distributed.initialize()
-        except Exception as e:  # pragma: no cover
-            print(f"[dist] initialize skipped: {e}")
+    try:
+        jax.distributed.initialize()
+    except Exception as e:  # pragma: no cover
+        print(f"[dist] initialize failed ({e}); continuing single-process")
 
 
 def _data_paths(train_cfg: TrainConfig, vocab_size: int) -> tuple[str, str]:
@@ -64,13 +73,18 @@ def _data_paths(train_cfg: TrainConfig, vocab_size: int) -> tuple[str, str]:
 
 
 def estimate_loss(eval_step, state, loaders: dict, eval_iters: int) -> dict:
-    """Mean eval loss over eval_iters random batches per split (reference
-    estimate_loss, single-gpu/train.py:280-293)."""
+    """Mean eval loss over eval_iters batches per split (reference
+    estimate_loss, single-gpu/train.py:280-293). Eval batches are keyed on
+    the eval-iteration counter k, NOT on the loaders' live counters, so (a)
+    the training stream is untouched by eval cadence and (b) every eval
+    call scores the same fixed batch set — val curves are comparable
+    point-to-point (a deliberate improvement over the reference's fresh
+    random batches per eval)."""
     out = {}
     for split, loader in loaders.items():
         losses = []
         for k in range(eval_iters):
-            x, y = loader.next_batch()
+            x, y = loader.next_batch(step=k)
             # eval consumes single micro-batches: take accum slot 0
             losses.append(eval_step(state, x[0], y[0]))
         out[split] = float(np.mean(jax.device_get(losses)))
@@ -85,6 +99,18 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
     maybe_initialize_distributed()
     is_main = jax.process_index() == 0
     say = (lambda s: log(s)) if is_main else (lambda s: None)
+
+    if model_cfg.moe:
+        # moe_impl lives in both configs (the CLI routes the flag to both,
+        # like the reference's act_recomp linking, train.py:189-190). For
+        # programmatic callers a non-default TrainConfig value wins, but a
+        # default ('dense') never silently downgrades an explicitly
+        # scatter-configured model.
+        want = train_cfg.moe_impl if train_cfg.moe_impl != "dense" \
+            else model_cfg.moe_impl
+        if want != model_cfg.moe_impl:
+            say(f"moe_impl: TrainConfig overrides model config -> {want}")
+            model_cfg = dataclasses.replace(model_cfg, moe_impl=want)
 
     mesh = mesh_for(train_cfg.parallelism, tp_size=train_cfg.tp_size,
                     ep_size=train_cfg.ep_size, sp_size=train_cfg.sp_size,
@@ -111,7 +137,11 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
     mk = lambda p, seed: DataLoader(p, b_glob, T, grad_accum=grad_accum,
                                     seed=seed, mesh=mesh, pspec=bspec)
     train_loader = mk(train_bin, train_cfg.seed)
+    # Eval gets its OWN loaders/streams: the training batch sequence is
+    # invariant to eval cadence (round-1 weak #6 — the reference shares one
+    # loader, so eval settings silently change the data order).
     val_loader = mk(val_bin, train_cfg.seed + 1)
+    eval_train_loader = mk(train_bin, train_cfg.seed + 2)
 
     # ---- model / state / steps ------------------------------------------
     model, tx, state, state_sharding = create_train_state(
@@ -143,20 +173,24 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
     if train_cfg.profile and is_main:
         jax.profiler.start_trace("profile_trace")
 
-    x, y = train_loader.next_batch()
+    # Training batches are keyed on the iteration number, so a resumed run
+    # continues the exact uninterrupted stream (round-1 weak #4: the loader
+    # was step-keyed but never fast-forwarded on resume).
+    x, y = train_loader.next_batch(step=start_step)
     t_prev = time.perf_counter()
     for it in range(start_step, train_cfg.max_iters + 1):
         if train_cfg.eval and it % train_cfg.eval_interval == 0:
             t0 = time.perf_counter()
             ev = estimate_loss(eval_step, state,
-                               {"train": train_loader, "val": val_loader},
+                               {"train": eval_train_loader,
+                                "val": val_loader},
                                train_cfg.eval_iters)
             stats["val_losses"].append((it, ev["val"]))
             say(f"iter {it}: train {ev['train']:.4f} val {ev['val']:.4f} "
                 f"({time.perf_counter() - t0:.1f}s)")
 
         state, m = train_step(state, x, y)
-        x, y = train_loader.next_batch()      # host prefetch while device runs
+        x, y = train_loader.next_batch(step=it + 1)  # host prefetch while device runs
         m = jax.device_get(m)                 # blocks on step completion
         t_now = time.perf_counter()
         dt = t_now - t_prev
@@ -194,11 +228,26 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
         say(f"final checkpoint -> {path}")
 
     stats["final_loss"] = stats["train_losses"][-1] if stats["train_losses"] else None
-    stats["state"] = state
     if stats["step_times"]:
         med = float(np.median(stats["step_times"]))
         stats["median_step_time"] = med
         stats["median_tokens_per_sec"] = tokens_per_step / med
         stats["median_mfu"] = (flops_per_step / med / (peak * n_chips)
                                if peak else None)
+    stats["params_total"], stats["params_active"] = int(total), int(active)
+
+    if train_cfg.save_stats and is_main:
+        # JSON-persisted run record (the reference's `<name>_stats.pt`,
+        # single-gpu/train.py:361-372, which round 1 let evaporate).
+        import json
+        record = {k: v for k, v in stats.items()}
+        record["model_config"] = dataclasses.asdict(model_cfg)
+        record["train_config"] = dataclasses.asdict(train_cfg)
+        os.makedirs(ckpt_root, exist_ok=True)
+        stats_path = os.path.join(ckpt_root, "stats.json")
+        with open(stats_path, "w") as f:
+            json.dump(record, f, indent=1)
+        say(f"stats -> {stats_path}")
+
+    stats["state"] = state
     return stats

@@ -22,10 +22,15 @@ needs_native = pytest.mark.skipif(not native.native_available(),
 
 @needs_native
 def test_native_matches_numpy_offsets():
+    """The C++ sample_offset and the NumPy philox_offsets must be
+    bit-identical — asserted directly on the exported offset stream."""
     rows = np.arange(64, dtype=np.uint32)
+    for seed, step, hi in [(1729, 3, 10_000), (42, 0, 7), (2 ** 63, 11, 31),
+                           (0, 2 ** 40, 999_983)]:
+        a = native.philox_offsets(seed, step, rows, hi)
+        b = native.native_offsets(seed, step, rows, hi)
+        assert (a == b).all(), (seed, step, hi)
     a = native.philox_offsets(1729, 3, rows, 10_000)
-    b = native.philox_offsets(1729, 3, rows, 10_000)
-    assert (a == b).all()
     c = native.philox_offsets(1729, 4, rows, 10_000)
     assert (a != c).any()  # step changes the stream
     d = native.philox_offsets(42, 3, rows, 10_000)

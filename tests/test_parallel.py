@@ -19,6 +19,9 @@ from distributed_pytorch_tpu.train.step import make_train_step
 TINY = dict(vocab_size=128, block_size=32, n_embd=32, n_head=4,
             n_kv_heads=2, n_layer=2, up_dim=64)
 MOE = dict(**TINY, moe=True, n_exp=8, n_shared=1, n_act=3)
+# scatter vs single-device dense oracle: generous capacity -> no drops, so
+# the trajectories must agree (the ep recipe's production dispatch)
+MOE_SCATTER = dict(**MOE, moe_impl="scatter", capacity_factor=8.0)
 
 
 def _batch(mc, accum, B, seed=0):
@@ -101,11 +104,12 @@ RECIPES = [
     ("fsdp_tp", TINY, {"tp_size": 2}),
     ("sp", TINY, {"sp_size": 2}),
     ("ep", MOE, {"ep_size": 2}),
+    ("ep", MOE_SCATTER, {"ep_size": 2}),
 ]
+_RECIPE_IDS = [r[0] for r in RECIPES[:-1]] + ["ep_scatter"]
 
 
-@pytest.mark.parametrize("recipe,mdict,kw", RECIPES,
-                         ids=[r[0] for r in RECIPES])
+@pytest.mark.parametrize("recipe,mdict,kw", RECIPES, ids=_RECIPE_IDS)
 def test_recipe_matches_single_device_oracle(recipe, mdict, kw):
     """Same init + same global batch -> same loss trajectory and params as
     the single-device trainer (DDP≡ZeRO≡FSDP≡single equivalence)."""

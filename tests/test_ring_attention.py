@@ -98,6 +98,17 @@ def test_sdpa_decode_shapes_bypass_sp(mesh24):
                                atol=1e-6)
 
 
+def test_sp_dropout_fallback_warns(mesh24):
+    """dropout>0 under a live seq axis silently defeats the sp recipe's
+    memory purpose — ADVICE r1: it must warn, not degrade quietly."""
+    B, T, nh, hs = 2, 64, 4, 16
+    q, k, v = rand_qkv(jax.random.PRNGKey(8), B, T, nh, nh, hs)
+    with context.use_mesh(mesh24):
+        with pytest.warns(RuntimeWarning, match="sequence-parallel"):
+            sdpa(q, k, v, causal=True, dropout_rate=0.1,
+                 dropout_rng=jax.random.PRNGKey(9), impl="auto")
+
+
 def test_sp_training_step_with_ring_matches_oracle():
     """End-to-end: the sp recipe's train step (ring attention active via
     'auto') reproduces the single-device optimizer step."""

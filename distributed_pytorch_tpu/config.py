@@ -85,8 +85,13 @@ class LLMConfig:
     kv_latent_dim: Optional[int] = 32
     rope_head_dim: Optional[int] = 16
 
-    # memory subsystem: selective activation recomputation (jax.remat)
+    # memory subsystem: activation recomputation (jax.remat). Two
+    # granularities, mirroring the reference's two variants: 'block' remats
+    # whole transformer Blocks (module model.py:677-680); 'attn' remats
+    # ONLY the attention sublayer (kaggle-ddp.py:526-534 — "memory grows
+    # O(T^2) for attn, O(T) for MoE"), the memory-relevant one on TPU.
     act_recomp: bool = False
+    act_recomp_policy: str = "block"  # 'block' | 'attn'
 
     def __post_init__(self):
         # Cross-field normalization, mirroring reference
@@ -119,6 +124,8 @@ class LLMConfig:
         assert self.moe_impl in ("dense", "scatter"), \
             f"unknown moe_impl {self.moe_impl!r}"
         assert self.capacity_factor > 0
+        assert self.act_recomp_policy in ("block", "attn"), \
+            f"unknown act_recomp_policy {self.act_recomp_policy!r}"
 
     @property
     def head_size(self) -> int:

@@ -43,11 +43,16 @@ class Block(nn.Module):
     """Pre-LN transformer block (reference model.py:508-533).
 
     `deterministic` is a module attribute (not a call arg) so the whole
-    block can be wrapped in `nn.remat` without static-argnum plumbing."""
+    block can be wrapped in `nn.remat` without static-argnum plumbing.
+    `remat_attn` remats only the attention sublayer — the reference's
+    deliberate kaggle-script granularity (kaggle-ddp.py:526-534): the
+    O(T^2) score tensor is recomputed in backward, the O(T) FFN/MoE
+    activations stay saved."""
 
     config: LLMConfig
     attn_impl: str = "auto"
     deterministic: bool = True
+    remat_attn: bool = False
 
     @nn.compact
     def __call__(self, x, freqs, cache=None, pos=0):
@@ -55,8 +60,19 @@ class Block(nn.Module):
         deterministic = self.deterministic
         ln1 = nn.LayerNorm(dtype=x.dtype, param_dtype=jnp.float32, name="ln1")
         ln2 = nn.LayerNorm(dtype=x.dtype, param_dtype=jnp.float32, name="ln2")
-        attn_out, new_cache = Attention(cfg, self.attn_impl)(
-            ln1(x), freqs, cache, pos, deterministic=deterministic)
+        attn = Attention(cfg, self.attn_impl)
+        if self.remat_attn:
+            # remat over a function whose only remat argument is the hidden
+            # state; freqs/cache/pos ride the closure (captured residuals,
+            # cheap) so the flavor modules' keyword-only `deterministic`
+            # needs no static-argnum plumbing. Param path stays `attn`.
+            def attn_fn(mdl, h):
+                return mdl(h, freqs, cache, pos, deterministic=deterministic)
+            attn_out, new_cache = nn.remat(attn_fn, prevent_cse=False)(
+                attn, ln1(x))
+        else:
+            attn_out, new_cache = attn(ln1(x), freqs, cache, pos,
+                                       deterministic=deterministic)
         x = x + attn_out
         if cfg.moe:
             moe_out, aux_loss = MoE(cfg, name="moe")(
@@ -121,14 +137,18 @@ class LLM(nn.Module):
             caches = [None] * cfg.n_layer
 
         block_cls = Block
+        remat_attn = False
         if cfg.act_recomp:
-            # Whole-block rematerialization (reference model.py:677-680).
-            block_cls = nn.remat(Block, prevent_cse=False)
+            if cfg.act_recomp_policy == "attn":
+                remat_attn = True  # attention-only (kaggle-ddp.py:526-534)
+            else:
+                # Whole-block rematerialization (reference model.py:677-680).
+                block_cls = nn.remat(Block, prevent_cse=False)
 
         new_caches = []
         total_aux = jnp.float32(0.0)
         for i in range(cfg.n_layer):
-            blk = block_cls(cfg, self.attn_impl, deterministic,
+            blk = block_cls(cfg, self.attn_impl, deterministic, remat_attn,
                             name=f"block_{i}")
             x, new_cache, aux = blk(x, freqs, caches[i], pos)
             new_caches.append(new_cache)

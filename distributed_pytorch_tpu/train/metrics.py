@@ -43,12 +43,10 @@ def peak_flops_per_chip() -> float | None:
     return None
 
 
-def matmul_params_per_token(cfg: LLMConfig) -> int:
-    """Active matmul parameters touched per token (MoE: shared + n_act_routed
-    routed experts only; cf. reference get_num_params 'active' count,
-    single-gpu/model.py:588-617)."""
+def attn_matmul_params_per_token(cfg: LLMConfig) -> int:
+    """Matmul parameters of the attention sublayer per token (per ALL
+    layers) — the recompute cost of the attention-only remat policy."""
     C, hs, nh, nkvh = cfg.n_embd, cfg.head_size, cfg.n_head, cfg.n_kv_heads
-
     if cfg.attn in ("mha", "mqa", "gqa"):
         attn = C * (C + 2 * nkvh * hs) + C * C          # c_attn + c_proj
     else:  # mla
@@ -58,6 +56,14 @@ def matmul_params_per_token(cfg: LLMConfig) -> int:
                 + C * C)                                 # W_o
         if cfg.pos_emb == "rope":
             attn += nlq * nh * cfg.rope_head_dim + C * cfg.rope_head_dim
+    return cfg.n_layer * attn
+
+
+def matmul_params_per_token(cfg: LLMConfig) -> int:
+    """Active matmul parameters touched per token (MoE: shared + n_act_routed
+    routed experts only; cf. reference get_num_params 'active' count,
+    single-gpu/model.py:588-617)."""
+    C = cfg.n_embd
 
     fc_out = 2 * cfg.up_dim if cfg.non_linearity.lower() in ("swiglu", "glu") \
         else cfg.up_dim
@@ -69,15 +75,25 @@ def matmul_params_per_token(cfg: LLMConfig) -> int:
         ffn = one_mlp
 
     lm_head = cfg.vocab_size * C                         # weight-tied matmul
-    return cfg.n_layer * (attn + ffn) + lm_head
+    return attn_matmul_params_per_token(cfg) \
+        + cfg.n_layer * ffn + lm_head
 
 
 def step_flops(cfg: LLMConfig, tokens_per_step: int, seq_len: int) -> float:
-    """Total train-step FLOPs (fwd + bwd [+ remat fwd])."""
-    per_tok_fwd = 2 * matmul_params_per_token(cfg) \
-        + cfg.n_layer * 2 * cfg.n_embd * seq_len  # causal attn: 4*T*C/2
-    mult = 4 if cfg.act_recomp else 3             # bwd = 2x fwd
-    return mult * per_tok_fwd * tokens_per_step
+    """Total train-step FLOPs (fwd + bwd [+ remat fwd]).
+
+    Remat accounting is policy-aware: 'block' re-runs the whole forward
+    (x4/3); 'attn' re-runs only attention projections + scores — counting
+    the full forward there would flatter MFU."""
+    score_flops = cfg.n_layer * 2 * cfg.n_embd * seq_len  # causal: 4*T*C/2
+    per_tok_fwd = 2 * matmul_params_per_token(cfg) + score_flops
+    recompute = 0.0
+    if cfg.act_recomp:
+        if cfg.act_recomp_policy == "attn":
+            recompute = 2 * attn_matmul_params_per_token(cfg) + score_flops
+        else:
+            recompute = per_tok_fwd
+    return (3 * per_tok_fwd + recompute) * tokens_per_step
 
 
 def mfu(cfg: LLMConfig, tokens_per_step: int, seq_len: int,

@@ -1,0 +1,100 @@
+#!/bin/bash
+# Single-host launcher: env-var config block rendered into CLI flags
+# (the TPU counterpart of reference single-gpu/train.sh:6-46 — same
+# pattern, one block of shell variables, conditional bool flags).
+# Edit the block, then:  bash scripts/train.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# --- Training configuration ---------------------------------------------
+DATASET='tinystories'          # shakespeare | tinystories | fineweb | synthetic
+TOTAL_BATCH_SIZE_STR="2**13"   # tokens per optimizer step (expression ok)
+BATCH_SIZE=2                   # micro-batch sequences per device
+MAX_ITERS=150000
+LEARNING_RATE=7e-5
+WARMUP_STEPS=500
+GRAD_CLIP=0.9
+EVAL=true
+EVAL_INTERVAL=100
+EVAL_ITERS=10
+SAVE_MODEL=true
+FILE_NAME="llm_model"
+ACT_RECOMP=true
+ACT_RECOMP_POLICY="attn"       # block | attn (attention-only recompute)
+
+# --- Parallelism (replaces the reference's choice of trainer script) ----
+PARALLELISM="single"           # single|dp|zero1|zero2|fsdp|tp|fsdp_tp|ep|sp
+PLATFORM="auto"                # auto | tpu | cpu (cpu = smoke runs)
+TP_SIZE=1
+EP_SIZE=1
+SP_SIZE=1
+
+# --- Model configuration ------------------------------------------------
+N_LAYER=12
+N_EMBD=1024
+VOCAB_SIZE=50304
+BLOCK_SIZE=1024
+DROPOUT=0.0                    # keep 0.0: fused attention + sp stay active
+POS_EMB="rope"                 # learn | sin | rope
+
+UP_DIM=768
+NON_LINEARITY="swiglu"
+
+ATTN="mla"                     # mha | mqa | gqa | mla
+N_HEAD=8
+N_KV_HEADS=4                   # gqa only
+Q_LATENT_DIM=256               # mla only
+KV_LATENT_DIM=256              # mla only
+ROPE_HEAD_DIM=128              # mla + rope only
+
+MOE=true
+MOE_IMPL="scatter"             # dense | scatter (capacity-bounded dispatch)
+N_EXP=16
+N_SHARED=1
+N_ACT=4
+AUX_FREE=true
+ALPHA=0.0001
+GAMMA=0.001
+COEFF=0.01
+
+# --- Render and run -----------------------------------------------------
+CMD=(python -m distributed_pytorch_tpu
+    --dataset "$DATASET"
+    --total_batch_size_str "$TOTAL_BATCH_SIZE_STR"
+    --batch_size "$BATCH_SIZE"
+    --max_iters "$MAX_ITERS"
+    --learning_rate "$LEARNING_RATE"
+    --warmup_steps "$WARMUP_STEPS"
+    --grad_clip "$GRAD_CLIP"
+    --eval_interval "$EVAL_INTERVAL"
+    --eval_iters "$EVAL_ITERS"
+    --file_name "$FILE_NAME"
+    --act_recomp_policy "$ACT_RECOMP_POLICY"
+    --parallelism "$PARALLELISM"
+    --platform "$PLATFORM"
+    --tp_size "$TP_SIZE" --ep_size "$EP_SIZE" --sp_size "$SP_SIZE"
+    --n_layer "$N_LAYER" --n_embd "$N_EMBD"
+    --vocab_size "$VOCAB_SIZE" --block_size "$BLOCK_SIZE"
+    --dropout "$DROPOUT" --pos_emb "$POS_EMB"
+    --up_dim "$UP_DIM" --non_linearity "$NON_LINEARITY"
+    --attn "$ATTN" --n_head "$N_HEAD" --n_kv_heads "$N_KV_HEADS"
+    --moe_impl "$MOE_IMPL"
+    --n_exp "$N_EXP" --n_shared "$N_SHARED" --n_act "$N_ACT"
+    --alpha "$ALPHA" --gamma "$GAMMA" --coeff "$COEFF")
+
+# conditional flags (reference train.sh:79-83 pattern)
+[ "$EVAL" = true ] && CMD+=(--eval)
+[ "$SAVE_MODEL" = true ] && CMD+=(--save_model)
+[ "$ACT_RECOMP" = true ] && CMD+=(--act_recomp)
+[ "$MOE" = true ] && CMD+=(--moe)
+[ "$AUX_FREE" = true ] && CMD+=(--aux_free)
+[ "$ATTN" = mla ] && CMD+=(--q_latent_dim "$Q_LATENT_DIM"
+                           --kv_latent_dim "$KV_LATENT_DIM")
+[ "$ATTN" = mla ] && [ "$POS_EMB" = rope ] && \
+    CMD+=(--rope_head_dim "$ROPE_HEAD_DIM")
+
+# extra flags win (argparse last-wins): bash scripts/train.sh --max_iters 10
+CMD+=("$@")
+
+echo "+ ${CMD[*]}"
+exec "${CMD[@]}"

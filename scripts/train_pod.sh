@@ -1,0 +1,76 @@
+#!/bin/bash
+# Multi-host (TPU pod / multi-slice) launcher.
+#
+# There is no torchrun on TPU: every host runs the SAME command and the
+# processes rendezvous through jax.distributed.initialize() (see
+# train/loop.py maybe_initialize_distributed — env-var gated, called
+# before any backend probe). On Cloud TPU VMs the coordinator/process
+# topology is auto-discovered from the TPU metadata, so plain
+#     bash scripts/train_pod.sh            # on every host
+# is enough. Off-TPU (CPU fleets, manual clusters) set the three envs:
+#     JAX_COORDINATOR_ADDRESS=host0:1234 \
+#     JAX_NUM_PROCESSES=4 JAX_PROCESS_ID=$i bash scripts/train_pod.sh
+#
+# Replaces reference multi-gpu/ddp/train.sh:49's
+# `torchrun --standalone --nproc_per_node=N train.py ...` (single-node
+# only); this one scales to multi-host, which the reference names as
+# future work (README.md:12).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+# On Cloud TPU pods these are injected by the runtime; exporting an
+# explicit trio here also works for manual bring-up.
+export JAX_COORDINATOR_ADDRESS="${JAX_COORDINATOR_ADDRESS:-}"
+export JAX_NUM_PROCESSES="${JAX_NUM_PROCESSES:-}"
+export JAX_PROCESS_ID="${JAX_PROCESS_ID:-}"
+
+# --- north-star config: FSDP GPT-124M on tinystories (BASELINE.json) ----
+PARALLELISM="fsdp"
+DATASET='tinystories'
+TOTAL_BATCH_SIZE_STR="2**19"   # 0.5M tokens/step across the pod
+BATCH_SIZE=8                   # micro-batch sequences PER HOST's devices
+MAX_ITERS=20000
+LEARNING_RATE=6e-4
+WARMUP_STEPS=700
+EVAL=true
+EVAL_INTERVAL=250
+EVAL_ITERS=20
+SAVE_MODEL=true
+FILE_NAME="gpt124m_fsdp"
+CKPT_INTERVAL=1000             # mid-run checkpoints -> resumable
+
+N_LAYER=12
+N_EMBD=768
+VOCAB_SIZE=50304
+BLOCK_SIZE=1024
+POS_EMB="rope"
+UP_DIM=3072
+NON_LINEARITY="swiglu"
+ATTN="mha"
+N_HEAD=12
+
+CMD=(python -m distributed_pytorch_tpu
+    --parallelism "$PARALLELISM"
+    --dataset "$DATASET"
+    --total_batch_size_str "$TOTAL_BATCH_SIZE_STR"
+    --batch_size "$BATCH_SIZE"
+    --max_iters "$MAX_ITERS"
+    --learning_rate "$LEARNING_RATE"
+    --warmup_steps "$WARMUP_STEPS"
+    --eval_interval "$EVAL_INTERVAL"
+    --eval_iters "$EVAL_ITERS"
+    --file_name "$FILE_NAME"
+    --ckpt_interval "$CKPT_INTERVAL"
+    --n_layer "$N_LAYER" --n_embd "$N_EMBD"
+    --vocab_size "$VOCAB_SIZE" --block_size "$BLOCK_SIZE"
+    --pos_emb "$POS_EMB" --up_dim "$UP_DIM"
+    --non_linearity "$NON_LINEARITY"
+    --attn "$ATTN" --n_head "$N_HEAD")
+[ "$EVAL" = true ] && CMD+=(--eval)
+[ "$SAVE_MODEL" = true ] && CMD+=(--save_model)
+
+# extra flags win (argparse last-wins)
+CMD+=("$@")
+
+echo "+ ${CMD[*]}"
+exec "${CMD[@]}"

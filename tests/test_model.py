@@ -97,9 +97,13 @@ def test_weight_tying_and_init_scale():
     assert 0.01 < std < 0.03  # N(0, 0.02) init (reference model.py:579-586)
 
 
-def test_act_recomp_matches_plain():
+@pytest.mark.parametrize("policy", ["block", "attn"])
+def test_act_recomp_matches_plain(policy):
+    """Both remat granularities (whole-Block, reference model.py:677-680;
+    attention-only, kaggle-ddp.py:526-534) are pure memory/FLOPs trades:
+    loss and grads must match the plain model."""
     cfg = tiny_config()
-    cfg_r = tiny_config(act_recomp=True)
+    cfg_r = tiny_config(act_recomp=True, act_recomp_policy=policy)
     model, model_r = LLM(cfg), LLM(cfg_r)
     idx = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, VOCAB)
     tgt = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, VOCAB)

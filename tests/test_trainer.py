@@ -123,6 +123,19 @@ def test_grad_accum_invariance():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
 
 
+def test_step_flops_policy_aware():
+    """MFU accounting must not flatter the attention-only remat: its
+    recompute term is strictly between no-remat and whole-block remat."""
+    from distributed_pytorch_tpu.train import metrics as M
+    base = dict(TINY)
+    plain = LLMConfig(**base)
+    blk = LLMConfig(**base, act_recomp=True, act_recomp_policy="block")
+    att = LLMConfig(**base, act_recomp=True, act_recomp_policy="attn")
+    f = lambda c: M.step_flops(c, tokens_per_step=1024, seq_len=32)
+    assert f(plain) < f(att) < f(blk)
+    assert f(blk) == pytest.approx(f(plain) * 4 / 3)
+
+
 def test_moe_state_updates_during_training():
     """Aux-free bias must move during training (reference model.py:466-470)
     and live in the train state."""

@@ -93,6 +93,12 @@ class LLMConfig:
     act_recomp: bool = False
     act_recomp_policy: str = "block"  # 'block' | 'attn'
 
+    # loss path: 'fused' computes CE blockwise over T without materializing
+    # the (B, T, V) logits (ops/losses.py — the round-3 MFU fix); 'unchunked'
+    # is the full-logits semantics oracle. loss_chunk: T-chunk size, 0 = auto.
+    loss_impl: str = "fused"
+    loss_chunk: int = 0
+
     def __post_init__(self):
         # Cross-field normalization, mirroring reference
         # single-gpu/train.py:198-206 (mha -> n_kv_heads=n_head, mqa -> 1,
@@ -126,6 +132,14 @@ class LLMConfig:
         assert self.capacity_factor > 0
         assert self.act_recomp_policy in ("block", "attn"), \
             f"unknown act_recomp_policy {self.act_recomp_policy!r}"
+        assert self.loss_impl in ("fused", "unchunked"), \
+            f"unknown loss_impl {self.loss_impl!r}"
+        if self.loss_chunk > 0:
+            # a non-dividing chunk would silently fall back to the
+            # full-logits path — fail loudly at config time instead
+            assert self.block_size % self.loss_chunk == 0, (
+                f"loss_chunk {self.loss_chunk} must divide block_size "
+                f"{self.block_size}")
 
     @property
     def head_size(self) -> int:

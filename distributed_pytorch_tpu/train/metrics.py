@@ -103,3 +103,19 @@ def mfu(cfg: LLMConfig, tokens_per_step: int, seq_len: int,
         return None
     achieved = step_flops(cfg, tokens_per_step, seq_len) / step_time_s
     return achieved / (peak * n_chips)
+
+
+def device_memory_gb() -> float | None:
+    """Peak device-memory use in GiB on the first local device, or None
+    when the backend doesn't report it (CPU). The TPU equivalent of the
+    reference's per-step `torch.cuda.memory_reserved()` print
+    (single-gpu/train.py:356) — the number that justifies batch-size
+    choices when chasing MFU (round-3 VERDICT #6)."""
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:  # pragma: no cover
+        return None
+    if not stats:
+        return None
+    b = stats.get("peak_bytes_in_use") or stats.get("bytes_in_use")
+    return b / 2 ** 30 if b else None

@@ -1,0 +1,113 @@
+"""MFU ablation sweep on the flagship bench config (round-4 VERDICT #1).
+
+Times the jitted train_step in isolation (device-resident data, no host
+loop) across the tuning axes the verdict names: batch size, attention
+implementation, activation recomputation, loss path. Prints one line per
+variant: ms/step, tokens/s, MFU, peak HBM.
+
+Usage:  python scripts/mfu_sweep.py [--iters 8] [--variants all|quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from distributed_pytorch_tpu.config import LLMConfig, TrainConfig
+from distributed_pytorch_tpu.train import metrics as M
+from distributed_pytorch_tpu.train.state import create_train_state
+from distributed_pytorch_tpu.train.step import make_train_step
+
+
+def time_variant(batch: int, attn_impl: str, act_recomp: bool,
+                 loss_impl: str, iters: int) -> dict | None:
+    model_cfg = LLMConfig(
+        vocab_size=50304, block_size=1024, n_embd=768, n_head=12,
+        n_kv_heads=12, attn="mha", n_layer=12, up_dim=3072,
+        non_linearity="swiglu", pos_emb="rope",
+        act_recomp=act_recomp, act_recomp_policy="attn",
+        loss_impl=loss_impl)
+    train_cfg = TrainConfig(
+        dataset="synthetic", total_batch_size=batch * 1024,
+        batch_size=batch, max_iters=iters, parallelism="single",
+        attn_impl=attn_impl, eval=False, save_model=False, save_stats=False,
+        compute_dtype="bfloat16")
+
+    try:
+        model, tx, state, state_sh = create_train_state(model_cfg, train_cfg)
+        step = make_train_step(model, tx, model_cfg, train_cfg, None, None)
+        rng = jax.random.PRNGKey(0)
+        x = jax.random.randint(rng, (1, batch, 1024), 0, 50304, jnp.int32)
+        y = jax.random.randint(rng, (1, batch, 1024), 0, 50304, jnp.int32)
+        state, m = step(state, x, y)       # compile + warmup
+        jax.block_until_ready(m)
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            state, m = step(state, x, y)
+            jax.block_until_ready(m)
+            times.append(time.perf_counter() - t0)
+    except Exception as e:  # OOM etc.
+        print(f"batch={batch:3d} attn={attn_impl:6s} remat={act_recomp!s:5s} "
+              f"loss={loss_impl:9s} FAILED: {type(e).__name__}: "
+              f"{str(e)[:120]}", flush=True)
+        return None
+
+    dt = float(np.median(times))
+    tokens = batch * 1024
+    flops = M.step_flops(model_cfg, tokens, 1024)
+    peak = M.peak_flops_per_chip()
+    mfu = flops / dt / peak if peak else float("nan")
+    hbm = M.device_memory_gb()
+    print(f"batch={batch:3d} attn={attn_impl:6s} remat={act_recomp!s:5s} "
+          f"loss={loss_impl:9s} | {dt * 1e3:7.1f} ms | "
+          f"{tokens / dt:9.0f} tok/s | mfu {mfu:6.2%} | hbm {hbm or 0:5.2f}GB",
+          flush=True)
+    return {"batch": batch, "attn": attn_impl, "remat": act_recomp,
+            "loss": loss_impl, "ms": dt * 1e3, "mfu": mfu}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument("--variants", default="quick")
+    args = ap.parse_args()
+
+    print(f"device: {jax.devices()[0].device_kind}, "
+          f"backend: {jax.default_backend()}", flush=True)
+
+    if args.variants == "quick":
+        grid = [
+            (16, "xla", False, "fused"),      # round-3 bench config + fused CE
+            (16, "xla", False, "unchunked"),  # round-3 baseline
+            (16, "pallas", False, "fused"),
+            (32, "xla", False, "fused"),
+            (32, "pallas", False, "fused"),
+            (32, "xla", True, "fused"),
+            (64, "pallas", True, "fused"),
+            (64, "xla", True, "fused"),
+        ]
+    else:
+        grid = list(itertools.product((16, 32, 64), ("xla", "pallas"),
+                                      (False, True), ("fused",)))
+
+    results = []
+    for batch, attn, remat, loss in grid:
+        r = time_variant(batch, attn, remat, loss, args.iters)
+        if r:
+            results.append(r)
+    if results:
+        best = max(results, key=lambda r: r["mfu"])
+        print(f"\nBEST: batch={best['batch']} attn={best['attn']} "
+              f"remat={best['remat']} loss={best['loss']} "
+              f"mfu={best['mfu']:.2%}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

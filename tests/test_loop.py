@@ -98,3 +98,56 @@ def test_stats_json_roundtrip(in_tmp):
     assert rec["model_config"]["n_embd"] == TINY["n_embd"]
     assert rec["train_config"]["file_name"] == "statrun"
     assert len(rec["step_times"]) == len(stats["step_times"])
+
+
+# ---------------------------------------------------------------------------
+# Multi-host bring-up gate (round-3 VERDICT #2): every announcement style a
+# real deployment uses must trigger initialize; a plain single-host run must
+# not. `initialize` is mocked — these tests never touch a backend.
+# ---------------------------------------------------------------------------
+
+from distributed_pytorch_tpu.train.loop import (maybe_initialize_distributed,
+                                                multihost_env_detected)
+
+
+@pytest.mark.parametrize("env,expected", [
+    ({}, False),                                             # plain laptop
+    ({"JAX_COORDINATOR_ADDRESS": "10.0.0.2:8476"}, True),    # explicit env
+    ({"JAX_NUM_PROCESSES": "4"}, True),
+    ({"TPU_WORKER_HOSTNAMES": "t0,t1,t2,t3"}, True),         # Cloud TPU pod
+    ({"TPU_WORKER_HOSTNAMES": "t0"}, False),                 # single-host slice
+    ({"TPU_WORKER_HOSTNAMES": ""}, False),
+    ({"MEGASCALE_COORDINATOR_ADDRESS": "head:8080"}, True),  # multislice
+])
+def test_multihost_env_detection(env, expected):
+    assert multihost_env_detected(env) is expected
+
+
+def test_initialize_called_on_pod_env(monkeypatch):
+    calls = []
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "t0,t1")
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False)
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda *a, **k: calls.append(1))
+    maybe_initialize_distributed()
+    assert calls == [1]
+
+
+def test_initialize_skipped_when_already_up(monkeypatch):
+    calls = []
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "2")
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: True)
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda *a, **k: calls.append(1))
+    maybe_initialize_distributed()
+    assert calls == []
+
+
+def test_initialize_not_called_single_host(monkeypatch):
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "TPU_WORKER_HOSTNAMES", "MEGASCALE_COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setattr(jax.distributed, "initialize",
+                        lambda *a, **k: (_ for _ in ()).throw(
+                            AssertionError("must not initialize")))
+    maybe_initialize_distributed()

@@ -85,40 +85,64 @@ def run_bench(platform: str) -> dict:
         model_cfg = LLMConfig(
             vocab_size=50304, block_size=1024, n_embd=768, n_head=12,
             n_kv_heads=12, attn="mha", n_layer=12, up_dim=3072,
-            non_linearity="swiglu", pos_emb="rope")
-        batch = int(os.environ.get("BENCH_BATCH", "16"))
+            non_linearity="swiglu", pos_emb="rope",
+            act_recomp=os.environ.get("BENCH_REMAT", "0") == "1",
+            act_recomp_policy="attn")
+        per_chip = int(os.environ.get("BENCH_BATCH", "16"))
         iters = int(os.environ.get("BENCH_ITERS", "12"))
+        attn_impl = os.environ.get("BENCH_ATTN", "auto")
     else:  # CPU smoke: tiny proxy so the harness still gets a line
         model_cfg = LLMConfig(
             vocab_size=1024, block_size=256, n_embd=256, n_head=8,
             n_kv_heads=8, attn="mha", n_layer=4, up_dim=1024,
             non_linearity="swiglu", pos_emb="rope")
-        batch, iters = 4, 6
+        per_chip, iters, attn_impl = 4, 6, "auto"
 
-    recipe = "fsdp" if n_dev > 1 else "single"
-    train_cfg = TrainConfig(
-        dataset="synthetic", data_dir="bench_data",
-        total_batch_size=batch * model_cfg.block_size,
-        batch_size=max(1, batch // n_dev),
-        max_iters=iters, parallelism=recipe,
-        log_interval=1, eval=False, save_model=False, save_stats=False,
-        compute_dtype="bfloat16")
+    def measure(recipe: str) -> dict:
+        # per-chip batch scales the global batch with the slice size, so the
+        # grad-accum divisibility assert can't fire on any n_dev (round-3
+        # VERDICT #5: BENCH_BATCH=16 fixed-global silently dropped >16-chip
+        # slices to the CPU proxy).
+        train_cfg = TrainConfig(
+            dataset="synthetic", data_dir="bench_data",
+            total_batch_size=per_chip * n_dev * model_cfg.block_size,
+            batch_size=per_chip,
+            max_iters=iters, parallelism=recipe, attn_impl=attn_impl,
+            log_interval=1, eval=False, save_model=False, save_stats=False,
+            compute_dtype="bfloat16")
+        stats = train(model_cfg, train_cfg,
+                      log=lambda s: print(f"[{recipe}] {s}", file=sys.stderr))
+        return {"tokens_per_sec_per_chip":
+                    round(stats["median_tokens_per_sec"] / n_dev, 1),
+                "mfu": stats.get("median_mfu"),
+                "peak_hbm_gb": stats.get("peak_hbm_gb")}
 
-    stats = train(model_cfg, train_cfg, log=lambda s: print(s, file=sys.stderr))
+    if n_dev > 1:
+        # BASELINE.md asks for the FSDP-vs-DDP MFU comparison; fsdp is the
+        # north-star headline number.
+        results = {"fsdp": measure("fsdp"), "dp": measure("dp")}
+        headline, recipe = results["fsdp"], "fsdp"
+    else:
+        recipe = "single"
+        results = {recipe: measure(recipe)}
+        headline = results[recipe]
 
-    tps_chip = stats["median_tokens_per_sec"] / n_dev
-    mfu = stats.get("median_mfu")
+    extra = {"n_chips": n_dev, "recipe": recipe,
+             "device": jax.devices()[0].device_kind,
+             "per_chip_batch": per_chip,
+             "recipes": {k: {kk: (round(vv, 4) if isinstance(vv, float) else vv)
+                             for kk, vv in v.items()}
+                         for k, v in results.items()}}
+    mfu = headline["mfu"]
     if mfu is not None:
         return {"metric": "mfu_gpt124m", "value": round(mfu, 4),
                 "unit": "fraction_of_peak",
                 "vs_baseline": round(mfu / 0.50, 4),
-                "tokens_per_sec_per_chip": round(tps_chip, 1),
-                "n_chips": n_dev, "recipe": recipe,
-                "device": jax.devices()[0].device_kind}
-    return {"metric": "tokens_per_sec_per_chip", "value": round(tps_chip, 1),
-            "unit": "tok/s/chip", "vs_baseline": 0,
-            "n_chips": n_dev, "recipe": recipe,
-            "device": jax.devices()[0].device_kind}
+                "tokens_per_sec_per_chip": headline["tokens_per_sec_per_chip"],
+                **extra}
+    return {"metric": "tokens_per_sec_per_chip",
+            "value": headline["tokens_per_sec_per_chip"],
+            "unit": "tok/s/chip", "vs_baseline": 0, **extra}
 
 
 def _worker_main(platform: str) -> None:

@@ -38,6 +38,7 @@ POS_EMB_KINDS = ("learn", "sin", "rope")
 # names them as unrealized goals).
 PARALLELISM_RECIPES = (
     "single", "dp", "zero1", "zero2", "fsdp", "tp", "fsdp_tp", "ep", "sp",
+    "pp",
 )
 
 
@@ -99,6 +100,14 @@ class LLMConfig:
     loss_impl: str = "fused"
     loss_chunk: int = 0
 
+    # pipeline parallelism (models/pipeline.py; the last member of the
+    # reference's "5D parallelism" goal, README.md:7). pp_stages > 1 stacks
+    # the transformer blocks on a leading layer axis (sharded over the
+    # 'pipe' mesh axis) and streams pp_microbatches batch slices through an
+    # interleaved per-layer schedule. 0 microbatches = auto (2 * stages).
+    pp_stages: int = 1
+    pp_microbatches: int = 0
+
     def __post_init__(self):
         # Cross-field normalization, mirroring reference
         # single-gpu/train.py:198-206 (mha -> n_kv_heads=n_head, mqa -> 1,
@@ -140,6 +149,12 @@ class LLMConfig:
             assert self.block_size % self.loss_chunk == 0, (
                 f"loss_chunk {self.loss_chunk} must divide block_size "
                 f"{self.block_size}")
+        if self.pp_stages > 1:
+            assert self.n_layer % self.pp_stages == 0, (
+                f"pp_stages {self.pp_stages} must divide n_layer "
+                f"{self.n_layer}")
+            assert not self.moe, \
+                "pipeline parallelism with MoE is not supported yet"
 
     @property
     def head_size(self) -> int:
@@ -189,6 +204,7 @@ class TrainConfig:
     tp_size: int = 1                 # model axis size (tp / fsdp_tp)
     ep_size: int = 1                 # expert axis size (ep)
     sp_size: int = 1                 # sequence axis size (sp / ring attention)
+    pp_size: int = 1                 # pipe axis size (pp; = LLMConfig.pp_stages)
     compute_dtype: str = "bfloat16"  # bf16 compute, fp32 params/opt state
     # attention kernel choice; under the 'sp' recipe, 'auto' and 'ring'
     # select ring attention over the 'seq' axis, 'ulysses' the all-to-all

@@ -1,0 +1,124 @@
+"""Prepare FineWeb: streaming shard tokenization → uint16 train.bin/val.bin.
+
+The reference DECLARES fineweb as its default dataset ("Has 10B tokens",
+single-gpu/train.sh:6; `Trainconfig` Literal, single-gpu/train.py:31) but
+ships no prepare script for it (SURVEY.md §2e) — this one exceeds the
+reference by existing. Design differences from the tinystories script,
+forced by scale:
+
+* HF `HuggingFaceFW/fineweb` is streamed (`streaming=True`): tokens are
+  appended to the .bins shard-by-shard, so preparing a 10B-token corpus
+  never needs the dataset (or the ids column) in RAM or on disk at once.
+* deterministic 1% val holdout: every 100th document goes to val — a
+  streaming-stable split (no global shuffle exists in a stream; the
+  reference's seed-1729 `train_test_split` needs the full dataset local).
+* `--limit N` stops after N documents (smoke tests / sub-corpora).
+* `--input FILE` treats a local text file (blank-line-separated documents)
+  as the corpus for air-gapped runs — this environment has no egress, so
+  the HF path errors gracefully with that pointer.
+
+Output is the loader's raw-uint16 format, same as every other prepare
+script (reference data/shakespeare/prepare.py:30-36).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import numpy as np
+
+from distributed_pytorch_tpu.data.prepare import get_tokenizer
+
+VAL_EVERY = 100  # 1% deterministic holdout
+
+
+class _BinWriter:
+    """Append uint16 tokens to <path>.part, atomically promote on close."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self.path = path
+        self.tmp = f"{path}.part.{os.getpid()}"
+        self.f = open(self.tmp, "wb")
+        self.n = 0
+
+    def append(self, ids) -> None:
+        arr = np.asarray(ids, dtype=np.uint16)
+        arr.tofile(self.f)
+        self.n += arr.size
+
+    def close(self) -> None:
+        self.f.close()
+        os.replace(self.tmp, self.path)
+        print(f"[prepare] wrote {self.path}: {self.n:,} tokens")
+
+    def abort(self) -> None:
+        """Discard the partial .part file — a truncated corpus must never
+        be promoted to train.bin (later runs would silently train on it)."""
+        self.f.close()
+        if os.path.exists(self.tmp):
+            os.remove(self.tmp)
+
+
+def _documents(args):
+    """Yield document strings from --input or the streamed HF dataset."""
+    if args.input:
+        with open(args.input, encoding="utf-8") as f:
+            blocks = f.read().split("\n\n")
+        for b in blocks:
+            if b.strip():
+                yield b.strip()
+        return
+    try:
+        from datasets import load_dataset
+        ds = load_dataset("HuggingFaceFW/fineweb", name=args.config,
+                          split="train", streaming=True)
+    except Exception as e:
+        raise SystemExit(
+            f"[prepare] cannot stream HuggingFaceFW/fineweb ({e}). "
+            "In an air-gapped environment, pass --input FILE with a local "
+            "corpus (blank-line-separated documents).") from e
+    for ex in ds:
+        yield ex["text"]
+
+
+def main(argv=None) -> None:
+    p = argparse.ArgumentParser(description="Prepare FineWeb .bins")
+    p.add_argument("--out_dir", default="data/fineweb")
+    p.add_argument("--config", default="sample-10BT",
+                   help="fineweb subset (sample-10BT matches the "
+                        "reference's '10B tokens' claim)")
+    p.add_argument("--input", default=None,
+                   help="local corpus file; skips the HF stream")
+    p.add_argument("--tokenizer", default="auto",
+                   choices=["auto", "gpt2", "byte"])
+    p.add_argument("--limit", type=int, default=0,
+                   help="stop after N documents (0 = all)")
+    args = p.parse_args(argv)
+
+    encode, eot, name = get_tokenizer(args.tokenizer)
+    train = _BinWriter(os.path.join(args.out_dir, "train.bin"))
+    val = _BinWriter(os.path.join(args.out_dir, "val.bin"))
+    try:
+        for i, text in enumerate(_documents(args)):
+            if args.limit and i >= args.limit:
+                break
+            ids = encode(text)
+            ids.append(eot)
+            (val if i % VAL_EVERY == 0 else train).append(ids)
+            if (i + 1) % 10000 == 0:
+                print(f"[prepare] {i + 1:,} docs, "
+                      f"{train.n + val.n:,} tokens ({name})")
+    except BaseException:
+        # promote only on clean completion; a stream that died mid-corpus
+        # leaves no .bin behind rather than a silently truncated one
+        train.abort()
+        val.abort()
+        raise
+    train.close()
+    val.close()
+
+
+if __name__ == "__main__":
+    main()

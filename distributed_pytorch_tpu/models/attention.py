@@ -109,7 +109,8 @@ class GQA(nn.Module):
             drop_rng = self.make_rng("dropout")
         y = sdpa(q, k.astype(q.dtype), v.astype(q.dtype), causal=True,
                  q_offset=q_offset, dropout_rate=cfg.dropout,
-                 dropout_rng=drop_rng, impl=self.attn_impl)
+                 dropout_rng=drop_rng, impl=self.attn_impl,
+                 decode=cache is not None)
         y = y.reshape(B, T, C)
         y = _dense(C, True, x.dtype, "c_proj")(y)
         y = nn.Dropout(cfg.dropout, deterministic=deterministic)(y)

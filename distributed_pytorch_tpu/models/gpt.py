@@ -135,26 +135,41 @@ class LLM(nn.Module):
 
         x = nn.Dropout(cfg.dropout, deterministic=deterministic)(x)
 
-        if caches is None:
-            caches = [None] * cfg.n_layer
+        if cfg.pp_stages > 1:
+            # pipeline-parallel block stack (models/pipeline.py): stacked
+            # layer axis over the 'pipe' mesh axis, microbatch tick loop
+            if caches is not None:
+                raise ValueError(
+                    "pipeline-parallel models don't support KV-cached "
+                    "decoding; restore the checkpoint with pp_stages=1 "
+                    "(train/checkpoint.py unstacks the block params) to "
+                    "sample from it")
+            from distributed_pytorch_tpu.models.pipeline import run_pipeline
+            x = run_pipeline(self, cfg, self.attn_impl, deterministic,
+                             x, freqs)
+            new_caches = [None] * cfg.n_layer
+            total_aux = jnp.float32(0.0)
+        else:
+            if caches is None:
+                caches = [None] * cfg.n_layer
 
-        block_cls = Block
-        remat_attn = False
-        if cfg.act_recomp:
-            if cfg.act_recomp_policy == "attn":
-                remat_attn = True  # attention-only (kaggle-ddp.py:526-534)
-            else:
-                # Whole-block rematerialization (reference model.py:677-680).
-                block_cls = nn.remat(Block, prevent_cse=False)
+            block_cls = Block
+            remat_attn = False
+            if cfg.act_recomp:
+                if cfg.act_recomp_policy == "attn":
+                    remat_attn = True  # attention-only (kaggle-ddp.py:526-534)
+                else:
+                    # Whole-block remat (reference model.py:677-680).
+                    block_cls = nn.remat(Block, prevent_cse=False)
 
-        new_caches = []
-        total_aux = jnp.float32(0.0)
-        for i in range(cfg.n_layer):
-            blk = block_cls(cfg, self.attn_impl, deterministic, remat_attn,
-                            name=f"block_{i}")
-            x, new_cache, aux = blk(x, freqs, caches[i], pos)
-            new_caches.append(new_cache)
-            total_aux = total_aux + aux
+            new_caches = []
+            total_aux = jnp.float32(0.0)
+            for i in range(cfg.n_layer):
+                blk = block_cls(cfg, self.attn_impl, deterministic,
+                                remat_attn, name=f"block_{i}")
+                x, new_cache, aux = blk(x, freqs, caches[i], pos)
+                new_caches.append(new_cache)
+                total_aux = total_aux + aux
 
         x = nn.LayerNorm(dtype=dt, param_dtype=jnp.float32, name="ln_f")(x)
 

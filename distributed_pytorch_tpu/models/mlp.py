@@ -121,17 +121,27 @@ class MLP(nn.Module):
 
 
 def _expert_constraint(t: jnp.ndarray) -> jnp.ndarray:
-    """Pin a leading-expert-axis tensor to the 'expert' mesh axis when the
-    ambient mesh has one — this is what makes GSPMD lower the scatter
-    dispatch's gather/return as all-to-alls over ICI instead of gathering
-    all tokens onto every expert shard."""
+    """Pin a (n_experts, capacity, ...) dispatch buffer to the mesh: expert
+    axis over 'expert' (GSPMD lowers dispatch/return as all-to-alls over
+    ICI instead of gathering all tokens onto every expert shard) and the
+    capacity axis over 'data'. The latter is what keeps per-device dispatch
+    memory independent of dp size (round-3 VERDICT #4): global capacity
+    grows with the global batch, but each device holds only its
+    cap/dp slice — without it, a dp x ep mesh materializes
+    (E/ep, cf*N_global*k/E, C) per device."""
     from distributed_pytorch_tpu.parallel import context
     mesh = context.get_mesh()
-    if mesh is None or "expert" not in mesh.axis_names \
-            or mesh.shape["expert"] <= 1:
+    if mesh is None:
         return t
-    spec = P(*(["expert"] + [None] * (t.ndim - 1)))
-    return jax.lax.with_sharding_constraint(t, NamedSharding(mesh, spec))
+    axes: list = [None] * t.ndim
+    if "expert" in mesh.axis_names and mesh.shape["expert"] > 1:
+        axes[0] = "expert"
+    if t.ndim >= 2 and "data" in mesh.axis_names \
+            and mesh.shape["data"] > 1 and t.shape[1] % mesh.shape["data"] == 0:
+        axes[1] = "data"
+    if all(a is None for a in axes):
+        return t
+    return jax.lax.with_sharding_constraint(t, NamedSharding(mesh, P(*axes)))
 
 
 def scatter_dispatch(x_flat: jnp.ndarray, topk_idx: jnp.ndarray,
@@ -259,6 +269,14 @@ class MoE(nn.Module):
         if cfg.moe_impl == "scatter":
             capacity = max(k, math.ceil(
                 cfg.capacity_factor * n_tokens * k / n_routed))
+            # round up so the buffers' capacity axis is divisible by the
+            # 'data' mesh axis and _expert_constraint can shard it (extra
+            # slots only ever reduce drops, never change kept tokens)
+            from distributed_pytorch_tpu.parallel import context
+            mesh = context.get_mesh()
+            if mesh is not None and "data" in mesh.axis_names:
+                dp = mesh.shape["data"]
+                capacity = -(-capacity // dp) * dp
             routed_out = scatter_dispatch(
                 x_flat, topk_idx, topk_gates,
                 experts_fc[n_shared:], experts_proj[n_shared:],

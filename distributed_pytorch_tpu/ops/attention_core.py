@@ -74,11 +74,16 @@ def sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
          q_offset: int | jnp.ndarray = 0,
          dropout_rate: float = 0.0,
          dropout_rng=None,
-         impl: str = "auto") -> jnp.ndarray:
+         impl: str = "auto",
+         decode: bool = False) -> jnp.ndarray:
     """Scaled dot-product attention over (B, T, N, H)-layout tensors.
 
     `q_offset` is the global position of q[:, 0] (nonzero during KV-cached
     decode, cf. reference start_pos plumbing at model.py:641-650).
+    `decode=True` marks a KV-cached call (prefill or single-token): it is
+    exempt from the ring/ulysses fail-loud check below — decoding is never
+    sequence-parallel, even when a prompt exactly fills the cache and the
+    shapes look like a training step.
     """
     hs = q.shape[-1]
     scale = (1.0 / hs ** 0.5) if scale is None else scale
@@ -128,7 +133,26 @@ def sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                 return sp_sdpa(q, k, v, scale=scale, causal=causal,
                                impl=sp_impl)
         if impl in ("ring", "ulysses"):
-            impl = "auto"  # shapes/mesh don't allow sp (e.g. decode steps)
+            # De-trap (round-3 VERDICT #9): an explicit ring/ulysses request
+            # on training-like shapes (full causal self-attention) with NO
+            # live 'seq' axis means the caller traced without
+            # context.use_mesh — the old silent GSPMD-full-gather fallback
+            # hid exactly the bug the ambient-mesh design risks. Fail loud.
+            # Decode-shaped calls (T != S, cache offsets) legitimately fall
+            # back: decoding isn't sequence-parallel even in sp training.
+            training_like = (causal and not decode
+                             and q.shape[1] == k.shape[1]
+                             and q.shape[1] > 1
+                             and isinstance(q_offset, int) and q_offset == 0)
+            if training_like and sp <= 1 and not context.in_sp_region():
+                raise ValueError(
+                    f"attn_impl={impl!r} requested but no live 'seq' mesh "
+                    "axis is visible at trace time. Establish the mesh "
+                    "around tracing (parallel.context.use_mesh, as the "
+                    "trainer's step builders do) or use the 'sp' recipe; "
+                    "a silent fallback here would lose sequence "
+                    "parallelism without any signal.")
+            impl = "auto"  # shapes don't allow sp (e.g. decode steps)
 
     if use_dropout:
         # only the naive path implements attention-weight dropout; honoring

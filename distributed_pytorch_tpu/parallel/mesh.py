@@ -10,8 +10,11 @@ four named axes:
 * 'model'  — tensor parallelism (attention heads / MLP up dim), rides ICI.
 * 'expert' — MoE expert parallelism.
 * 'seq'    — sequence/context parallelism (ring attention).
+* 'pipe'   — pipeline parallelism: the stacked transformer-block layer
+             axis shards over it (models/pipeline.py); innermost so stage
+             boundary transfers ride ICI neighbors.
 
-All four axes always exist (size 1 when unused): recipes differ only in
+All five axes always exist (size 1 when unused): recipes differ only in
 axis *sizes* and in which PartitionSpecs mention them, so every recipe
 shares one jit cache key structure and one train_step.
 
@@ -30,7 +33,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
-AXES = ("data", "seq", "expert", "model")
+AXES = ("data", "seq", "expert", "model", "pipe")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -41,17 +44,18 @@ class MeshPlan:
     seq: int = 1
     expert: int = 1
     model: int = 1
+    pipe: int = 1
 
     @property
     def n_devices(self) -> int:
-        return self.data * self.seq * self.expert * self.model
+        return self.data * self.seq * self.expert * self.model * self.pipe
 
-    def axis_sizes(self) -> tuple[int, int, int, int]:
-        return (self.data, self.seq, self.expert, self.model)
+    def axis_sizes(self) -> tuple[int, int, int, int, int]:
+        return (self.data, self.seq, self.expert, self.model, self.pipe)
 
 
 def resolve_plan(recipe: str, n_devices: int, *, tp_size: int = 1,
-                 ep_size: int = 1, sp_size: int = 1,
+                 ep_size: int = 1, sp_size: int = 1, pp_size: int = 1,
                  dp_size: int = -1) -> MeshPlan:
     """Compute axis sizes for `recipe` over `n_devices`.
 
@@ -65,16 +69,17 @@ def resolve_plan(recipe: str, n_devices: int, *, tp_size: int = 1,
     devices land on 'data'.
     """
     if recipe == "single":
-        return MeshPlan(1, 1, 1, 1)
+        return MeshPlan(1, 1, 1, 1, 1)
     tp, ep, sp = tp_size, ep_size, sp_size
-    denom = tp * ep * sp
+    pp = pp_size
+    denom = tp * ep * sp * pp
     assert n_devices % denom == 0, (
-        f"recipe {recipe!r} needs tp*ep*sp={denom} dividing device count "
+        f"recipe {recipe!r} needs tp*ep*sp*pp={denom} dividing device count "
         f"{n_devices}")
     dp = n_devices // denom if dp_size == -1 else dp_size
     assert dp * denom == n_devices, (
-        f"dp_size {dp} * tp*ep*sp {denom} != {n_devices} devices")
-    return MeshPlan(data=dp, seq=sp, expert=ep, model=tp)
+        f"dp_size {dp} * tp*ep*sp*pp {denom} != {n_devices} devices")
+    return MeshPlan(data=dp, seq=sp, expert=ep, model=tp, pipe=pp)
 
 
 def build_mesh(plan: MeshPlan,
@@ -91,11 +96,11 @@ def build_mesh(plan: MeshPlan,
 
 
 def mesh_for(recipe: str, *, tp_size: int = 1, ep_size: int = 1,
-             sp_size: int = 1, dp_size: int = -1,
+             sp_size: int = 1, pp_size: int = 1, dp_size: int = -1,
              devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """One-call convenience: resolve + build for the current device set."""
     devs = list(devices if devices is not None else jax.devices())
     n = 1 if recipe == "single" else len(devs)
     plan = resolve_plan(recipe, n, tp_size=tp_size, ep_size=ep_size,
-                        sp_size=sp_size, dp_size=dp_size)
+                        sp_size=sp_size, pp_size=pp_size, dp_size=dp_size)
     return build_mesh(plan, devs)

@@ -94,18 +94,32 @@ def _largest_divisible_axis(shape, n: int, taken: set[int]) -> Optional[int]:
 
 def spec_for_param(names: tuple[str, ...], shape: tuple[int, ...],
                    recipe: Recipe, mesh: Mesh) -> P:
-    """PartitionSpec for one parameter (or same-shaped opt-state leaf)."""
+    """PartitionSpec for one parameter (or same-shaped opt-state leaf).
+
+    Stacked-pipeline leaves (path under 'blocks', models/pipeline.py) carry
+    a leading layer axis: it shards over 'pipe' (that IS the stage
+    assignment — contiguous L/S layer groups per stage) and every
+    positional rule below shifts right by one."""
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     axes: list[Optional[str]] = [None] * len(shape)
     taken: set[int] = set()
 
+    stacked = bool(names) and names[0] == "blocks"
+    off = 1 if stacked else 0
+    if stacked:
+        taken.add(0)  # the layer axis belongs to 'pipe' (or stays whole)
+        if sizes.get("pipe", 1) > 1 and shape[0] % sizes["pipe"] == 0:
+            axes[0] = "pipe"
+
     if sizes.get("expert", 1) > 1 and names and \
             names[-1].startswith("experts_"):
-        axes[0] = "expert"
-        taken.add(0)
+        axes[off] = "expert"
+        taken.add(off)
 
     if sizes.get("model", 1) > 1:
         ti = _tp_axis(names)
+        if ti is not None:
+            ti += off
         if ti is not None and ti < len(shape) and \
                 shape[ti] % sizes["model"] == 0 and ti not in taken:
             axes[ti] = "model"

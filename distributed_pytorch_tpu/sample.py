@@ -67,7 +67,18 @@ def main(argv=None) -> None:
                                    batch_size=train_cfg.batch_size),
         jax.random.PRNGKey(0))
     state = ckpt.restore_for_inference(path, abstract)
-    variables = {"params": state.params}
+    params = state.params
+    if model_cfg.pp_stages > 1:
+        # pipeline checkpoints store the blocks stacked on a layer axis;
+        # decoding runs the loop model, so unstack and rebuild
+        # (models/pipeline.py — pp doesn't support KV caches itself)
+        import dataclasses as _dc
+        from distributed_pytorch_tpu.models.pipeline import unstack_block_params
+        params = unstack_block_params(params, model_cfg.n_layer)
+        model_cfg = _dc.replace(model_cfg, pp_stages=1, pp_microbatches=0)
+        model = build_model(model_cfg, train_cfg)
+        print("pp checkpoint: unstacked block params for decoding")
+    variables = {"params": params}
     if state.moe_state:
         variables["moe_state"] = state.moe_state
 

@@ -153,9 +153,17 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
             say(f"moe_impl: TrainConfig overrides model config -> {want}")
             model_cfg = dataclasses.replace(model_cfg, moe_impl=want)
 
+    if train_cfg.pp_size > 1 and model_cfg.pp_stages != train_cfg.pp_size:
+        # the pipe mesh axis and the model's stacked-stage count are one
+        # decision; the trainer flag wins (same linking pattern as
+        # act_recomp, reference train.py:189-190)
+        say(f"pp: setting model pp_stages = pp_size = {train_cfg.pp_size}")
+        model_cfg = dataclasses.replace(model_cfg,
+                                        pp_stages=train_cfg.pp_size)
+
     mesh = mesh_for(train_cfg.parallelism, tp_size=train_cfg.tp_size,
                     ep_size=train_cfg.ep_size, sp_size=train_cfg.sp_size,
-                    dp_size=train_cfg.dp_size)
+                    pp_size=train_cfg.pp_size, dp_size=train_cfg.dp_size)
     sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     n_chips = int(np.prod(mesh.devices.shape))
     say(f"mesh {sizes} over {n_chips} {jax.devices()[0].device_kind} "

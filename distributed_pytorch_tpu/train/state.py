@@ -85,10 +85,27 @@ def init_train_state(rng: jax.Array, model: LLM, model_cfg: LLMConfig,
                      batch_size: int = 2) -> TrainState:
     """Initialize params (+ moe_state) and optimizer state. Runs under
     jit/eval_shape so it can be staged out with shardings (see
-    create_train_state)."""
+    create_train_state).
+
+    Pipeline models (pp_stages > 1) are initialized via the LOOP variant of
+    the same config and then restacked: every recipe starts from
+    bit-identical weights for a given seed, which is what makes the
+    pp-vs-single-device parity test (and cross-recipe reproducibility)
+    hold — nn.vmap's split param rngs would otherwise init each layer
+    differently from the loop model."""
+    import dataclasses as _dc
     dummy = jnp.zeros((batch_size, model_cfg.block_size), jnp.int32)
-    variables = model.init({"params": rng, "dropout": rng}, dummy, dummy)
-    params = variables["params"]
+    if model_cfg.pp_stages > 1:
+        from distributed_pytorch_tpu.models.pipeline import stack_block_params
+        loop_cfg = _dc.replace(model_cfg, pp_stages=1)
+        loop_model = LLM(loop_cfg, compute_dtype=model.compute_dtype,
+                         attn_impl=model.attn_impl)
+        variables = loop_model.init({"params": rng, "dropout": rng},
+                                    dummy, dummy)
+        params = stack_block_params(variables["params"], model_cfg.n_layer)
+    else:
+        variables = model.init({"params": rng, "dropout": rng}, dummy, dummy)
+        params = variables["params"]
     moe_state = variables.get("moe_state", {})
     opt_state = tx.init(params)
     return TrainState(step=jnp.zeros((), jnp.int32), params=params,

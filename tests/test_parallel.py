@@ -26,6 +26,9 @@ MOE_SCATTER = dict(**MOE, moe_impl="scatter", capacity_factor=8.0)
 # auto-chunks, so an explicit loss_chunk makes sharded runs exercise the
 # scan + checkpoint over 'data'/'model'-sharded embeddings
 TINY_CHUNKED = dict(**TINY, loss_chunk=8)
+# pipeline parallelism (models/pipeline.py): stacked blocks over 'pipe',
+# 4 microbatches of 2 sequences through a 2-deep layer stack
+TINY_PP = dict(**TINY, pp_stages=2, pp_microbatches=4)
 
 
 def _batch(mc, accum, B, seed=0):
@@ -122,10 +125,13 @@ RECIPES = [
     # vocab-parallel): the scan path must match the oracle exactly
     ("fsdp", TINY_CHUNKED, {}),
     ("tp", TINY_CHUNKED, {"tp_size": 2}),
+    # pipeline parallelism: dp=4 x pipe=2 — the interleaved schedule must
+    # reproduce the oracle trajectory exactly (same stacked init)
+    ("pp", TINY_PP, {"pp_size": 2}),
 ]
-_RECIPE_IDS = [r[0] for r in RECIPES[:-5]] + [
+_RECIPE_IDS = [r[0] for r in RECIPES[:-6]] + [
     "ep_scatter", "fsdp_x_ep", "fsdp_x_sp", "fsdp_chunked_ce",
-    "tp_chunked_ce"]
+    "tp_chunked_ce", "pp"]
 
 
 _ORACLE_CACHE: dict = {}
@@ -152,7 +158,13 @@ def test_recipe_matches_single_device_oracle(recipe, mdict, kw):
 
     # NB total_batch_size is informational to the loop, not the step; the
     # step consumes whatever (accum, B, T) it is given.
-    oracle_losses = _oracle_losses(mc, x, y)
+    # The pp oracle is the plain LOOP model: the pipeline run starts from
+    # the stacked loop init (train/state.py), so its trajectory must match
+    # the non-pipelined model's — the strongest parity claim available.
+    import dataclasses as _dc
+    oracle_cfg = _dc.replace(mc, pp_stages=1, pp_microbatches=0) \
+        if mc.pp_stages > 1 else mc
+    oracle_losses = _oracle_losses(oracle_cfg, x, y)
 
     tc = TrainConfig(total_batch_size=2 * 8 * 32 // 2, batch_size=1,
                      learning_rate=1e-3, warmup_steps=2,

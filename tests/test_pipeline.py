@@ -1,0 +1,153 @@
+"""Pipeline parallelism (models/pipeline.py): the interleaved schedule must
+be a pure re-scheduling of the loop model — identical forward, identical
+gradients, stage-sharded params — plus stack/unstack round trips for
+checkpoint interop. The reference names PP as a goal but has no code
+(/root/reference/README.md:7); the oracle here is our own loop model."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pytorch_tpu.config import LLMConfig
+from distributed_pytorch_tpu.models.gpt import LLM
+from distributed_pytorch_tpu.models.pipeline import (stack_block_params,
+                                                     unstack_block_params)
+
+KW = dict(vocab_size=96, block_size=32, n_embd=32, n_head=4, n_kv_heads=2,
+          n_layer=4, up_dim=48, pos_emb="rope", attn="gqa",
+          non_linearity="swiglu")
+
+
+def _models(pp_microbatches=4):
+    loop_cfg = LLMConfig(**KW)
+    pp_cfg = LLMConfig(**KW, pp_stages=2, pp_microbatches=pp_microbatches)
+    idx = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 96)
+    tgt = jax.random.randint(jax.random.PRNGKey(2), (8, 16), 0, 96)
+    loop_model, pp_model = LLM(loop_cfg), LLM(pp_cfg)
+    variables = loop_model.init(jax.random.PRNGKey(0), idx, tgt)
+    pp_params = stack_block_params(variables["params"], KW["n_layer"])
+    return loop_model, pp_model, variables, pp_params, idx, tgt
+
+
+def test_pp_init_structure_matches_stacked_loop():
+    """model.init of the pp model and stack_block_params of the loop init
+    must agree on tree structure AND leaf shapes — this is the contract
+    that lets train/state.py seed pipelines from loop weights."""
+    loop_model, pp_model, variables, pp_params, idx, tgt = _models()
+    pp_init = pp_model.init(jax.random.PRNGKey(0), idx, tgt)
+    assert jax.tree_util.tree_structure(pp_init["params"]) == \
+        jax.tree_util.tree_structure(pp_params)
+    jax.tree_util.tree_map(lambda a, b: None if a.shape == b.shape else
+                           pytest.fail(f"{a.shape} != {b.shape}"),
+                           pp_init["params"], pp_params)
+
+
+def test_pp_forward_matches_loop():
+    loop_model, pp_model, variables, pp_params, idx, tgt = _models()
+    _, loss_loop, _ = loop_model.apply(variables, idx, tgt)
+    _, loss_pp, _ = pp_model.apply({"params": pp_params}, idx, tgt)
+    np.testing.assert_allclose(float(loss_pp), float(loss_loop), rtol=1e-6)
+
+
+@pytest.mark.parametrize("m", [1, 2, 8])
+def test_pp_microbatch_count_invariance(m):
+    """The schedule result cannot depend on how the batch is sliced."""
+    loop_model, pp_model, variables, pp_params, idx, tgt = _models(m)
+    _, loss_loop, _ = loop_model.apply(variables, idx, tgt)
+    _, loss_pp, _ = pp_model.apply({"params": pp_params}, idx, tgt)
+    np.testing.assert_allclose(float(loss_pp), float(loss_loop), rtol=1e-6)
+
+
+def test_pp_gradients_match_loop():
+    loop_model, pp_model, variables, pp_params, idx, tgt = _models()
+
+    g_loop = jax.grad(
+        lambda p: loop_model.apply({"params": p}, idx, tgt)[1])(
+        variables["params"])
+    g_pp = jax.grad(
+        lambda p: pp_model.apply({"params": p}, idx, tgt)[1])(pp_params)
+    g_pp_unstacked = unstack_block_params(g_pp, KW["n_layer"])
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(b), np.asarray(a), rtol=5e-4, atol=1e-5),
+        g_loop, g_pp_unstacked)
+
+
+def test_stack_unstack_roundtrip():
+    loop_model, _, variables, pp_params, _, _ = _models()
+    back = unstack_block_params(pp_params, KW["n_layer"])
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        variables["params"], back)
+
+
+def test_pp_params_sharded_over_pipe():
+    """Under the pp recipe the stacked layer axis is the stage assignment:
+    every blocks/ leaf must carry 'pipe' on axis 0."""
+    from distributed_pytorch_tpu.config import TrainConfig
+    from distributed_pytorch_tpu.parallel.mesh import build_mesh, resolve_plan
+    from distributed_pytorch_tpu.train.state import create_train_state
+
+    mc = LLMConfig(**KW, pp_stages=2, pp_microbatches=4)
+    tc = TrainConfig(parallelism="pp", pp_size=2, batch_size=8,
+                     total_batch_size=8 * 32)
+    mesh = build_mesh(resolve_plan("pp", 8, pp_size=2))  # data=4 x pipe=2
+    _, _, state, _ = create_train_state(mc, tc, mesh)
+    stacked = state.params["blocks"]["stack"]
+    for leaf in jax.tree_util.tree_leaves(stacked):
+        assert leaf.sharding.spec[0] == "pipe", leaf.sharding.spec
+        assert leaf.addressable_shards[0].data.shape[0] == KW["n_layer"] // 2
+
+
+def test_pp_rejects_decode_caches():
+    mc = LLMConfig(**KW, pp_stages=2)
+    model = LLM(mc)
+    idx = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 96)
+    variables = model.init(jax.random.PRNGKey(0), idx, idx)
+    from distributed_pytorch_tpu.models.gpt import init_cache
+    caches = init_cache(mc, 2)
+    with pytest.raises(ValueError, match="decoding"):
+        model.apply(variables, idx, None, caches, 0)
+
+
+def test_pp_checkpoint_unstacks_for_sampling(tmp_path, monkeypatch):
+    """End-to-end: train 2 steps under pp, checkpoint, unstack, and verify
+    the loop model reproduces the pipeline model's eval loss."""
+    monkeypatch.chdir(tmp_path)
+    from distributed_pytorch_tpu.config import TrainConfig
+    from distributed_pytorch_tpu.train.loop import train
+
+    mc = LLMConfig(vocab_size=256, block_size=32, n_embd=32, n_head=4,
+                   n_kv_heads=2, n_layer=2, up_dim=48,
+                   pp_stages=2, pp_microbatches=2)
+    tc = TrainConfig(dataset="synthetic", data_dir="bench_data",
+                     # 8 CPU devices -> pipe=2, leftover dp=4: global batch
+                     # = batch_size*dp = 16 sequences of 32 tokens
+                     total_batch_size=16 * 32, batch_size=4, max_iters=2,
+                     parallelism="pp", pp_size=2, save_model=True,
+                     save_stats=False, file_name="ppruns")
+    stats = train(mc, tc, log=lambda s: None)
+
+    pp_params = jax.device_get(stats["state"].params)
+    loop_params = unstack_block_params(pp_params, mc.n_layer)
+    loop_cfg = dataclasses.replace(mc, pp_stages=1, pp_microbatches=0)
+    idx = jax.random.randint(jax.random.PRNGKey(5), (2, 32), 0, 256)
+    _, l_loop, _ = LLM(loop_cfg).apply({"params": loop_params}, idx, idx)
+    _, l_pp, _ = LLM(mc).apply({"params": pp_params}, idx, idx)
+    np.testing.assert_allclose(float(l_loop), float(l_pp), rtol=1e-5)
+
+
+@pytest.mark.parametrize("policy", ["block", "attn"])
+def test_pp_act_recomp_matches_plain(policy):
+    """Remat under pp is a pure memory/FLOPs trade: same loss as plain pp
+    (and hence as the loop oracle)."""
+    loop_model, pp_model, variables, pp_params, idx, tgt = _models()
+    cfg_r = LLMConfig(**KW, pp_stages=2, pp_microbatches=4,
+                      act_recomp=True, act_recomp_policy=policy)
+    _, loss_pp, _ = pp_model.apply({"params": pp_params}, idx, tgt)
+    _, loss_r, _ = LLM(cfg_r).apply({"params": pp_params}, idx, tgt)
+    np.testing.assert_allclose(float(loss_r), float(loss_pp), rtol=1e-6)

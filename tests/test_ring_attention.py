@@ -149,3 +149,22 @@ def test_sp_training_step_with_ring_matches_oracle():
     for a, b in zip(flat1, flat2):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
                                    atol=2e-4)
+
+
+def test_ring_without_mesh_fails_loudly():
+    """De-trap (round-3 VERDICT #9): an explicit ring/ulysses request traced
+    WITHOUT an ambient mesh must raise, not silently lose sequence
+    parallelism (the routing is a trace-time decision outside jit's cache
+    key)."""
+    import pytest
+    from distributed_pytorch_tpu.ops.attention_core import sdpa
+
+    q = jnp.zeros((2, 16, 4, 8))
+    for impl in ("ring", "ulysses"):
+        with pytest.raises(ValueError, match="seq"):
+            sdpa(q, q, q, impl=impl)
+    # decode-shaped calls (KV longer than Q, cache offset) legitimately
+    # fall back — sp never applies to decode even in sp training
+    kv = jnp.zeros((2, 32, 4, 8))
+    out = sdpa(q[:, :1], kv, kv, impl="ring", q_offset=31)
+    assert out.shape == (2, 1, 4, 8)

@@ -56,8 +56,13 @@ def tpu_available(attempts: int = 2, timeout_s: int = 240) -> bool:
     return False
 
 
-def run_bench(platform: str) -> dict:
-    """Worker-side measurement. `platform` is 'tpu' or 'cpu'."""
+def run_bench(platform: str, only_recipe: str | None = None) -> dict:
+    """Worker-side measurement. `platform` is 'tpu' or 'cpu'.
+
+    On a multi-chip slice each recipe is measured in its OWN worker process
+    (`only_recipe`): peak_bytes_in_use is process-monotone, so measuring
+    fsdp then dp in one process would report dp's peak HBM as
+    max(fsdp, dp) — the parent merges the per-recipe JSON lines instead."""
     import jax
 
     if platform == "cpu":
@@ -82,10 +87,8 @@ def run_bench(platform: str) -> dict:
         # rather than grinding the 124M config on a CPU.
         assert jax.default_backend() == "tpu", \
             f"TPU probe passed but worker got {jax.default_backend()!r}"
-        model_cfg = LLMConfig(
-            vocab_size=50304, block_size=1024, n_embd=768, n_head=12,
-            n_kv_heads=12, attn="mha", n_layer=12, up_dim=3072,
-            non_linearity="swiglu", pos_emb="rope",
+        from distributed_pytorch_tpu.config import flagship_gpt124m
+        model_cfg = flagship_gpt124m(
             act_recomp=os.environ.get("BENCH_REMAT", "0") == "1",
             act_recomp_policy="attn")
         per_chip = int(os.environ.get("BENCH_BATCH", "16"))
@@ -108,7 +111,9 @@ def run_bench(platform: str) -> dict:
             total_batch_size=per_chip * n_dev * model_cfg.block_size,
             batch_size=per_chip,
             max_iters=iters, parallelism=recipe, attn_impl=attn_impl,
-            log_interval=1, eval=False, save_model=False, save_stats=False,
+            # sync every 4 steps: host round-trips overlap device compute
+            # (train/loop.py sync discipline), like a real pod run would
+            log_interval=4, eval=False, save_model=False, save_stats=False,
             compute_dtype="bfloat16")
         stats = train(model_cfg, train_cfg,
                       log=lambda s: print(f"[{recipe}] {s}", file=sys.stderr))
@@ -119,13 +124,13 @@ def run_bench(platform: str) -> dict:
 
     if n_dev > 1:
         # BASELINE.md asks for the FSDP-vs-DDP MFU comparison; fsdp is the
-        # north-star headline number.
-        results = {"fsdp": measure("fsdp"), "dp": measure("dp")}
-        headline, recipe = results["fsdp"], "fsdp"
+        # north-star headline number. This worker measures ONE recipe; the
+        # parent launches a second worker for dp and merges.
+        recipe = only_recipe or "fsdp"
     else:
         recipe = "single"
-        results = {recipe: measure(recipe)}
-        headline = results[recipe]
+    results = {recipe: measure(recipe)}
+    headline = results[recipe]
 
     extra = {"n_chips": n_dev, "recipe": recipe,
              "device": jax.devices()[0].device_kind,
@@ -145,15 +150,18 @@ def run_bench(platform: str) -> dict:
             "unit": "tok/s/chip", "vs_baseline": 0, **extra}
 
 
-def _worker_main(platform: str) -> None:
-    print(json.dumps(run_bench(platform)))
+def _worker_main(platform: str, only_recipe: str | None = None) -> None:
+    print(json.dumps(run_bench(platform, only_recipe)))
 
 
-def _spawn_worker(platform: str, timeout_s: int) -> dict | None:
+def _spawn_worker(platform: str, timeout_s: int,
+                  only_recipe: str | None = None) -> dict | None:
     """Run the worker subprocess; return its parsed JSON line or None."""
     try:
-        r = subprocess.run([sys.executable, __file__, "--worker", platform],
-                           capture_output=True, timeout=timeout_s)
+        cmd = [sys.executable, __file__, "--worker", platform]
+        if only_recipe:
+            cmd.append(only_recipe)
+        r = subprocess.run(cmd, capture_output=True, timeout=timeout_s)
         sys.stderr.write(r.stderr.decode()[-4000:])
         if r.returncode == 0 and r.stdout:
             for line in reversed(r.stdout.decode().strip().splitlines()):
@@ -173,12 +181,19 @@ def _spawn_worker(platform: str, timeout_s: int) -> dict | None:
 
 def main() -> None:
     if len(sys.argv) > 2 and sys.argv[1] == "--worker":
-        _worker_main(sys.argv[2])
+        _worker_main(sys.argv[2],
+                     sys.argv[3] if len(sys.argv) > 3 else None)
         return
 
     out = None
     if tpu_available():
         out = _spawn_worker("tpu", timeout_s=1800)
+        if out and out.get("n_chips", 1) > 1:
+            # second worker for the DDP leg of the FSDP-vs-DDP comparison
+            # (fresh process -> uncontaminated peak-HBM stats)
+            dp = _spawn_worker("tpu", timeout_s=1800, only_recipe="dp")
+            if dp and dp.get("recipes"):
+                out.setdefault("recipes", {}).update(dp["recipes"])
     else:
         sys.stderr.write("[bench] TPU unavailable -> CPU fallback\n")
     if out is None:

@@ -169,6 +169,19 @@ class LLMConfig:
         return self.n_act - self.n_shared
 
 
+def flagship_gpt124m(**overrides) -> "LLMConfig":
+    """The headline GPT-2-124M-class benchmark model (BASELINE.json north
+    star; the config the reference's single-gpu/train.sh trains at
+    block_size 1024). One definition shared by bench.py, the MFU sweep and
+    profiler scripts, and the driver entry — so every measurement measures
+    the same model."""
+    base = dict(vocab_size=50304, block_size=1024, n_embd=768, n_head=12,
+                n_kv_heads=12, attn="mha", n_layer=12, up_dim=3072,
+                non_linearity="swiglu", pos_emb="rope")
+    base.update(overrides)
+    return LLMConfig(**base)
+
+
 @dataclass(frozen=True)
 class TrainConfig:
     """Training hyperparameters. Mirrors reference `Trainconfig`

@@ -27,12 +27,10 @@ from distributed_pytorch_tpu.train.step import make_train_step
 
 def time_variant(batch: int, attn_impl: str, act_recomp: bool,
                  loss_impl: str, iters: int) -> dict | None:
-    model_cfg = LLMConfig(
-        vocab_size=50304, block_size=1024, n_embd=768, n_head=12,
-        n_kv_heads=12, attn="mha", n_layer=12, up_dim=3072,
-        non_linearity="swiglu", pos_emb="rope",
-        act_recomp=act_recomp, act_recomp_policy="attn",
-        loss_impl=loss_impl)
+    from distributed_pytorch_tpu.config import flagship_gpt124m
+    model_cfg = flagship_gpt124m(act_recomp=act_recomp,
+                                 act_recomp_policy="attn",
+                                 loss_impl=loss_impl)
     train_cfg = TrainConfig(
         dataset="synthetic", total_batch_size=batch * 1024,
         batch_size=batch, max_iters=iters, parallelism="single",
@@ -47,12 +45,13 @@ def time_variant(batch: int, attn_impl: str, act_recomp: bool,
         y = jax.random.randint(rng, (1, batch, 1024), 0, 50304, jnp.int32)
         state, m = step(state, x, y)       # compile + warmup
         jax.block_until_ready(m)
-        times = []
+        # async dispatch, one sync at the end — the trainer's sync
+        # discipline (train/loop.py): host round-trips overlap compute
+        t0 = time.perf_counter()
         for _ in range(iters):
-            t0 = time.perf_counter()
             state, m = step(state, x, y)
-            jax.block_until_ready(m)
-            times.append(time.perf_counter() - t0)
+        jax.block_until_ready(m)
+        times = [(time.perf_counter() - t0) / iters]
     except Exception as e:  # OOM etc.
         print(f"batch={batch:3d} attn={attn_impl:6s} remat={act_recomp!s:5s} "
               f"loss={loss_impl:9s} FAILED: {type(e).__name__}: "

@@ -1,0 +1,112 @@
+"""Capture + analyze a TPU profile of the flagship train step (VERDICT #1a).
+
+Runs a few steps of the bench config under jax.profiler, then parses the
+xplane protobuf with tensorboard_plugin_profile's converter and prints the
+op-level time breakdown — no TensorBoard UI needed (this container has no
+browser). The output is the evidence for which kernel eats the step.
+
+Usage: python scripts/profile_step.py [--batch 16] [--attn auto] [--remat]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def capture(batch: int, attn_impl: str, remat: bool, loss_impl: str,
+            trace_dir: str, iters: int = 6) -> None:
+    from distributed_pytorch_tpu.config import LLMConfig, TrainConfig
+    from distributed_pytorch_tpu.train.state import create_train_state
+    from distributed_pytorch_tpu.train.step import make_train_step
+
+    from distributed_pytorch_tpu.config import flagship_gpt124m
+    model_cfg = flagship_gpt124m(act_recomp=remat, act_recomp_policy="attn",
+                                 loss_impl=loss_impl)
+    train_cfg = TrainConfig(
+        dataset="synthetic", total_batch_size=batch * 1024,
+        batch_size=batch, max_iters=iters, parallelism="single",
+        attn_impl=attn_impl, eval=False, save_model=False, save_stats=False,
+        compute_dtype="bfloat16")
+
+    model, tx, state, _ = create_train_state(model_cfg, train_cfg)
+    step = make_train_step(model, tx, model_cfg, train_cfg, None, None)
+    rng = jax.random.PRNGKey(0)
+    x = jax.random.randint(rng, (1, batch, 1024), 0, 50304, jnp.int32)
+    y = jax.random.randint(rng, (1, batch, 1024), 0, 50304, jnp.int32)
+    state, m = step(state, x, y)
+    jax.block_until_ready(m)           # compile outside the trace
+
+    with jax.profiler.trace(trace_dir):
+        for _ in range(iters):
+            state, m = step(state, x, y)
+        jax.block_until_ready(m)
+
+
+def analyze(trace_dir: str, top: int = 25) -> None:
+    """Parse the newest xplane.pb and print per-op device time.
+
+    Reads the XSpace proto directly (tensorflow.tsl xplane_pb2 — the
+    tensorboard-plugin converter in this image is ABI-mismatched with its
+    TF build): for the device plane, aggregate event durations by op name
+    on each line and print the busiest line's breakdown."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    xplanes = sorted(glob.glob(os.path.join(
+        trace_dir, "plugins/profile/*/*.xplane.pb")))
+    assert xplanes, f"no xplane.pb under {trace_dir}"
+    space = xplane_pb2.XSpace()
+    with open(xplanes[-1], "rb") as f:
+        space.ParseFromString(f.read())
+
+    device_planes = [p for p in space.planes
+                     if "TPU" in p.name or "/device" in p.name.lower()]
+    planes = device_planes or list(space.planes)
+    for plane in planes:
+        ev_names = {m.id: m.name for m in plane.event_metadata.values()}
+        best_line, best_tot = None, 0
+        per_line = {}
+        for line in plane.lines:
+            agg: dict[str, float] = {}
+            for ev in line.events:
+                name = ev_names.get(ev.metadata_id, "?")
+                agg[name] = agg.get(name, 0.0) + ev.duration_ps / 1e6  # us
+            tot = sum(agg.values())
+            per_line[line.name] = (tot, agg)
+            if tot > best_tot:
+                best_line, best_tot = line.name, tot
+        if not best_line:
+            continue
+        print(f"\n=== plane {plane.name!r}: busiest line {best_line!r} "
+              f"({best_tot / 1e3:.1f} ms total) ===")
+        tot, agg = per_line[best_line]
+        for name, t in sorted(agg.items(), key=lambda kv: -kv[1])[:top]:
+            print(f"{t:12.1f} us  {100 * t / tot:5.1f}%  {name[:90]}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--attn", default="auto")
+    ap.add_argument("--remat", action="store_true")
+    ap.add_argument("--loss", default="fused")
+    ap.add_argument("--trace_dir", default="profile_trace")
+    ap.add_argument("--analyze_only", action="store_true")
+    args = ap.parse_args()
+
+    if not args.analyze_only:
+        print(f"device: {jax.devices()[0].device_kind}", flush=True)
+        capture(args.batch, args.attn, args.remat, args.loss,
+                args.trace_dir)
+    analyze(args.trace_dir)
+
+
+if __name__ == "__main__":
+    main()

@@ -13,6 +13,8 @@ output is printed as ids.
 from __future__ import annotations
 
 import argparse
+import contextlib
+import dataclasses
 import os
 
 import jax
@@ -40,6 +42,10 @@ def main(argv=None) -> None:
     p.add_argument("--top_k", type=int, default=50)
     p.add_argument("--num_samples", type=int, default=1)
     p.add_argument("--seed", type=int, default=1729)
+    p.add_argument("--shard", action="store_true",
+                   help="restore the checkpoint sharded over all local "
+                        "devices using its training recipe's layout — for "
+                        "models larger than one device's memory")
     args = p.parse_args(argv)
 
     from distributed_pytorch_tpu.models.generate import make_generate_fn
@@ -66,16 +72,41 @@ def main(argv=None) -> None:
         lambda r: init_train_state(r, model, model_cfg, tx,
                                    batch_size=train_cfg.batch_size),
         jax.random.PRNGKey(0))
-    state = ckpt.restore_for_inference(path, abstract)
+    shardings = None
+    mesh = None
+    if args.shard and len(jax.devices()) > 1:
+        from distributed_pytorch_tpu.parallel.mesh import mesh_for
+        from distributed_pytorch_tpu.train.state import (state_shardings,
+                                                         state_spec_tree)
+        mesh = mesh_for(train_cfg.parallelism, tp_size=train_cfg.tp_size,
+                        ep_size=train_cfg.ep_size, sp_size=train_cfg.sp_size,
+                        pp_size=train_cfg.pp_size)
+        spec_tree = state_spec_tree(abstract, train_cfg.parallelism, mesh)
+        shardings = state_shardings(abstract, train_cfg.parallelism, mesh)
+        from jax.sharding import PartitionSpec as P
+        n_sharded = sum(
+            1 for s in jax.tree_util.tree_leaves(
+                spec_tree.params, is_leaf=lambda x: isinstance(x, P))
+            if any(a is not None for a in s))
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        if n_sharded:
+            print(f"sharded restore: mesh {sizes}, {n_sharded} param "
+                  f"leaves sharded ({train_cfg.parallelism} layout)")
+        else:
+            print(f"--shard: recipe {train_cfg.parallelism!r} replicates "
+                  "all params — restore is NOT memory-sharded (use an "
+                  "fsdp/tp/pp checkpoint for models larger than one "
+                  "device)")
+    state = ckpt.restore_for_inference(path, abstract, shardings)
     params = state.params
     if model_cfg.pp_stages > 1:
         # pipeline checkpoints store the blocks stacked on a layer axis;
         # decoding runs the loop model, so unstack and rebuild
         # (models/pipeline.py — pp doesn't support KV caches itself)
-        import dataclasses as _dc
         from distributed_pytorch_tpu.models.pipeline import unstack_block_params
         params = unstack_block_params(params, model_cfg.n_layer)
-        model_cfg = _dc.replace(model_cfg, pp_stages=1, pp_microbatches=0)
+        model_cfg = dataclasses.replace(model_cfg, pp_stages=1,
+                                        pp_microbatches=0)
         model = build_model(model_cfg, train_cfg)
         print("pp checkpoint: unstacked block params for decoding")
     variables = {"params": params}
@@ -93,11 +124,14 @@ def main(argv=None) -> None:
     gen = make_generate_fn(model, args.max_new_tokens,
                            temperature=args.temperature, top_k=args.top_k)
     rng = jax.random.PRNGKey(args.seed)
-    for i in range(args.num_samples):
-        out = gen(variables, prompt, jax.random.fold_in(rng, i))
-        toks = jax.device_get(out)[0].tolist()
-        print("-" * 40)
-        print(enc.decode(toks) if enc is not None else toks)
+    from distributed_pytorch_tpu.parallel import context
+    with (context.use_mesh(mesh) if mesh is not None
+          else contextlib.nullcontext()):
+        for i in range(args.num_samples):
+            out = gen(variables, prompt, jax.random.fold_in(rng, i))
+            toks = jax.device_get(out)[0].tolist()
+            print("-" * 40)
+            print(enc.decode(toks) if enc is not None else toks)
 
 
 if __name__ == "__main__":

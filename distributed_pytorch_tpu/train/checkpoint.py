@@ -80,16 +80,25 @@ def restore_checkpoint(path: str, abstract_state: Any,
                              abstract_state)
 
 
-def restore_for_inference(path: str, abstract_state: Any) -> TrainState:
+def restore_for_inference(path: str, abstract_state: Any,
+                          shardings: Any = None) -> TrainState:
     """Restore ONLY params + moe_state (opt_state leaves are skipped via
     orbax PLACEHOLDER, which StandardCheckpointer rejects but the PyTree
     handler honors): the sampling CLI reads a third of the bytes a full
-    TrainState restore would."""
-    one = jax.sharding.SingleDeviceSharding(jax.local_devices()[0])
+    TrainState restore would.
+
+    `shardings`: optional pytree (matching abstract_state) of Shardings —
+    pass the recipe tables' NamedShardings to restore a model larger than
+    one device's memory directly into its mesh shards (sample.py --shard;
+    round-3 weak #7). Default: everything on one local device."""
+    if shardings is None:
+        one = jax.sharding.SingleDeviceSharding(jax.local_devices()[0])
+        shardings = jax.tree_util.tree_map(lambda s: one, abstract_state)
     abstract_state = dataclasses.replace(
         jax.tree_util.tree_map(
-            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=one),
-            abstract_state),
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype,
+                                               sharding=sh),
+            abstract_state, shardings),
         opt_state=jax.tree_util.tree_map(lambda _: ocp.PLACEHOLDER,
                                          abstract_state.opt_state))
     restore_args = jax.tree_util.tree_map(

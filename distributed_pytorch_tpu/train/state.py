@@ -135,8 +135,21 @@ def create_train_state(model_cfg: LLMConfig, train_cfg: TrainConfig,
     if mesh is None:
         return model, tx, jax.jit(init_fn)(rng), None
 
-    recipe = train_cfg.parallelism
     state_shapes = jax.eval_shape(init_fn, rng)
+    state_sharding = state_shardings(state_shapes, train_cfg.parallelism,
+                                     mesh)
+    state = jax.jit(init_fn, out_shardings=state_sharding)(rng)
+    return model, tx, state, state_sharding
+
+
+def state_spec_tree(state_shapes: TrainState, recipe: str,
+                    mesh) -> TrainState:
+    """PartitionSpec tree for a TrainState: the ONE definition of how a
+    recipe lays out the full state, shared by the trainer init
+    (create_train_state) and the sharded sampling restore (sample.py
+    --shard) so the two can't diverge."""
+    from distributed_pytorch_tpu.parallel import sharding as shd
+
     p_specs = shd.params_pspecs(state_shapes.params, recipe, mesh)
     p_shapes = jax.tree_util.tree_map(lambda l: tuple(l.shape),
                                       state_shapes.params)
@@ -144,8 +157,12 @@ def create_train_state(model_cfg: LLMConfig, train_cfg: TrainConfig,
                                       p_specs, recipe, mesh)
     moe_specs = jax.tree_util.tree_map(lambda l: shd.P(),
                                        state_shapes.moe_state)
-    spec_tree = TrainState(step=shd.P(), params=p_specs,
-                           opt_state=opt_specs, moe_state=moe_specs)
-    state_sharding = shd.named(mesh, spec_tree)
-    state = jax.jit(init_fn, out_shardings=state_sharding)(rng)
-    return model, tx, state, state_sharding
+    return TrainState(step=shd.P(), params=p_specs,
+                      opt_state=opt_specs, moe_state=moe_specs)
+
+
+def state_shardings(state_shapes: TrainState, recipe: str,
+                    mesh) -> TrainState:
+    """NamedSharding tree for a TrainState (spec tree bound to `mesh`)."""
+    from distributed_pytorch_tpu.parallel import sharding as shd
+    return shd.named(mesh, state_spec_tree(state_shapes, recipe, mesh))

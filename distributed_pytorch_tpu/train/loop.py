@@ -73,10 +73,34 @@ def maybe_initialize_distributed() -> None:
         return
     if jax.distributed.is_initialized():
         return
+    # jax.distributed.initialize() auto-detects only TPU-pod / Slurm / MPI
+    # environments; the explicit JAX_* env convention (our launchers, and
+    # the round-4 two-process CPU test that caught this) must be passed as
+    # arguments or initialize raises "Number of processes must be defined".
+    #
+    # Failure here is FATAL: the env announced a multi-process topology, so
+    # continuing single-process would have N hosts training disconnected on
+    # the full dataset and race-writing the same checkpoints — the silent
+    # failure mode this function exists to prevent. The reference's
+    # torchrun path likewise rendezvouses or dies (ddp/train.py:19-25).
     try:
-        jax.distributed.initialize()
-    except Exception as e:  # pragma: no cover
-        print(f"[dist] initialize failed ({e}); continuing single-process")
+        kwargs = {}
+        if os.environ.get("JAX_COORDINATOR_ADDRESS"):
+            kwargs["coordinator_address"] = \
+                os.environ["JAX_COORDINATOR_ADDRESS"]
+        if os.environ.get("JAX_NUM_PROCESSES"):
+            kwargs["num_processes"] = int(os.environ["JAX_NUM_PROCESSES"])
+        if os.environ.get("JAX_PROCESS_ID"):
+            kwargs["process_id"] = int(os.environ["JAX_PROCESS_ID"])
+        jax.distributed.initialize(**kwargs)
+    except Exception as e:
+        raise RuntimeError(
+            "[dist] multi-process environment detected but "
+            f"jax.distributed.initialize failed: {e}. Refusing to continue "
+            "single-process (hosts would train disconnected). Check "
+            "JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID "
+            "(all hosts need distinct integer process ids) or unset them "
+            "for a single-process run.") from e
 
 
 def _data_paths(train_cfg: TrainConfig, vocab_size: int) -> tuple[str, str]:

@@ -151,3 +151,14 @@ def test_initialize_not_called_single_host(monkeypatch):
                         lambda *a, **k: (_ for _ in ()).throw(
                             AssertionError("must not initialize")))
     maybe_initialize_distributed()
+
+
+def test_initialize_failure_is_fatal(monkeypatch):
+    """A detected multi-process env with a failing initialize must abort,
+    not silently train disconnected (the reference's torchrun likewise
+    rendezvouses or dies, multi-gpu/ddp/train.py:19-25)."""
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "2")
+    monkeypatch.setenv("JAX_PROCESS_ID", "not-an-int")
+    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False)
+    with pytest.raises(RuntimeError, match="disconnected"):
+        maybe_initialize_distributed()

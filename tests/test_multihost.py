@@ -1,0 +1,117 @@
+"""Real multi-process training test: two coordinated JAX processes (Gloo
+over localhost), each with 4 CPU devices, train fsdp on the 8-device global
+mesh. This is the capability the reference gets from torchrun + NCCL
+(multi-gpu/ddp/train.py:19-25) and the row SURVEY/VERDICT marked 'never
+executed multi-process anywhere' — and it caught a real bug: in jax 0.9,
+`jax.distributed.initialize()` only auto-detects TPU/Slurm/MPI, so the
+explicit JAX_* env convention must be forwarded as arguments
+(train/loop.py maybe_initialize_distributed)."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+_WORKER = textwrap.dedent("""
+    import os, sys, json
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 4)
+    sys.path.insert(0, __REPO__)
+    from distributed_pytorch_tpu.config import LLMConfig, TrainConfig
+    from distributed_pytorch_tpu.train.loop import train
+
+    mc = LLMConfig(vocab_size=256, block_size=32, n_embd=32, n_head=4,
+                   n_kv_heads=2, n_layer=2, up_dim=48)
+    tc = TrainConfig(dataset="synthetic", data_dir=os.environ["MH_DATA"],
+                     total_batch_size=8 * 1 * 32, batch_size=1, max_iters=3,
+                     parallelism="fsdp", save_stats=False)
+    stats = train(mc, tc, log=lambda s: None)
+    print(json.dumps({"procs": jax.process_count(),
+                      "devices": len(jax.devices()),
+                      "losses": stats["train_losses"]}))
+""")
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_training_matches_single(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = tmp_path / "worker.py"
+    worker.write_text(_WORKER.replace("__REPO__", repr(repo)))
+    data_dir = str(tmp_path / "data")
+    port = _free_port()  # fixed ports collide across concurrent runs
+
+    def run(pid):
+        env = dict(os.environ,
+                   JAX_COORDINATOR_ADDRESS=f"localhost:{port}",
+                   JAX_NUM_PROCESSES="2", JAX_PROCESS_ID=str(pid),
+                   MH_DATA=data_dir,
+                   PYTHONPATH=repo + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        # workers pin their own platform/devices; drop the suite's env
+        env.pop("JAX_PLATFORMS", None)
+        env.pop("XLA_FLAGS", None)
+        return subprocess.Popen([sys.executable, str(worker)], env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.PIPE)
+
+    procs = [run(0), run(1)]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=420)
+            assert p.returncode == 0, err.decode()[-2000:]
+            import json
+            outs.append(json.loads(out.decode().strip().splitlines()[-1]))
+    finally:
+        for p in procs:  # a failure above must not leak a blocked worker
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+    for o in outs:
+        assert o["procs"] == 2, f"processes ran disconnected: {o}"
+        assert o["devices"] == 8
+    # both processes observe the same global loss trajectory...
+    assert outs[0]["losses"] == outs[1]["losses"]
+
+    # ...and it equals the single-process 8-device run of the same config:
+    # the counter-based loader + GSPMD make the math process-count-invariant
+    # (the reference's +rank seed offsets cannot offer this).
+    single = subprocess.run(
+        [sys.executable, "-c", textwrap.dedent(f"""
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_num_cpu_devices", 8)
+            import sys, os, json
+            sys.path.insert(0, {repo!r})
+            os.environ["MH_DATA"] = {data_dir!r}
+            from distributed_pytorch_tpu.config import LLMConfig, TrainConfig
+            from distributed_pytorch_tpu.train.loop import train
+            mc = LLMConfig(vocab_size=256, block_size=32, n_embd=32,
+                           n_head=4, n_kv_heads=2, n_layer=2, up_dim=48)
+            tc = TrainConfig(dataset="synthetic",
+                             data_dir=os.environ["MH_DATA"],
+                             total_batch_size=8 * 1 * 32, batch_size=1,
+                             max_iters=3, parallelism="fsdp",
+                             save_stats=False)
+            stats = train(mc, tc, log=lambda s: None)
+            print(json.dumps(stats["train_losses"]))
+        """)],
+        capture_output=True, timeout=420,
+        env={k: v for k, v in os.environ.items()
+             if k not in ("JAX_PLATFORMS", "XLA_FLAGS")})
+    assert single.returncode == 0, single.stderr.decode()[-2000:]
+    import json
+    oracle = json.loads(single.stdout.decode().strip().splitlines()[-1])
+    np.testing.assert_allclose(outs[0]["losses"], oracle, rtol=2e-4)

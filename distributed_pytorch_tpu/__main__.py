@@ -3,7 +3,8 @@
 Replaces all five reference trainer invocations (single-gpu/train.py,
 torchrun'd multi-gpu/ddp/train.py, and the three kaggle scripts): the
 parallelism strategy is `--parallelism {single,dp,zero1,zero2,fsdp,tp,
-fsdp_tp,ep,sp}` instead of a choice of script, and there is no torchrun —
+fsdp_tp,ep,sp,pp}` (axis sizes compose, e.g. --parallelism fsdp
+--ep_size 2) instead of a choice of script, and there is no torchrun —
 on a TPU pod every host runs this same command (see scripts/train.sh).
 Flag surface mirrors the reference's ~33 argparse flags
 (single-gpu/train.py:136-181), including --total_batch_size_str "2**14".

@@ -40,16 +40,25 @@ from distributed_pytorch_tpu.config import LLMConfig
 
 
 def _pipe_constraint(t: jnp.ndarray) -> jnp.ndarray:
-    """Pin the leading layer axis of an (L, ...) buffer to 'pipe' when the
-    ambient mesh has a live pipe axis (same ambient-mesh pattern as the
-    MoE dispatch constraint, models/mlp.py)."""
+    """Pin an (L, b, ...) pipeline buffer to the mesh: layer axis over
+    'pipe', and — when pp composes with dp and the microbatch divides —
+    the batch axis over 'data', so each device computes only its batch
+    slice of its layers every tick (same ambient-mesh pattern as the MoE
+    dispatch constraint, models/mlp.py)."""
     from distributed_pytorch_tpu.parallel import context
     mesh = context.get_mesh()
-    if mesh is None or "pipe" not in mesh.axis_names \
-            or mesh.shape["pipe"] <= 1 or t.shape[0] % mesh.shape["pipe"]:
+    if mesh is None:
         return t
-    spec = P(*(["pipe"] + [None] * (t.ndim - 1)))
-    return jax.lax.with_sharding_constraint(t, NamedSharding(mesh, spec))
+    axes: list = [None] * t.ndim
+    if "pipe" in mesh.axis_names and mesh.shape["pipe"] > 1 \
+            and t.shape[0] % mesh.shape["pipe"] == 0:
+        axes[0] = "pipe"
+    if t.ndim >= 2 and "data" in mesh.axis_names \
+            and mesh.shape["data"] > 1 and t.shape[1] % mesh.shape["data"] == 0:
+        axes[1] = "data"
+    if all(a is None for a in axes):
+        return t
+    return jax.lax.with_sharding_constraint(t, NamedSharding(mesh, P(*axes)))
 
 
 class _PipeTick(nn.Module):

@@ -45,8 +45,17 @@ def multihost_env_detected(environ=None) -> bool:
     * multislice (megascale) coordinator: MEGASCALE_COORDINATOR_ADDRESS.
     """
     env = environ if environ is not None else os.environ
-    if env.get("JAX_COORDINATOR_ADDRESS") or env.get("JAX_NUM_PROCESSES"):
+    if env.get("JAX_COORDINATOR_ADDRESS"):
         return True
+    nproc = env.get("JAX_NUM_PROCESSES")
+    if nproc:
+        try:
+            if int(nproc) > 1:
+                return True
+            # N=1 is semantically single-process (e.g. a pod launcher
+            # template run on one host) — not a distributed topology
+        except ValueError:
+            return True  # malformed: surface initialize's fatal error
     hosts = [h for h in env.get("TPU_WORKER_HOSTNAMES", "").split(",")
              if h.strip()]
     if len(hosts) > 1:

@@ -114,6 +114,8 @@ from distributed_pytorch_tpu.train.loop import (maybe_initialize_distributed,
     ({}, False),                                             # plain laptop
     ({"JAX_COORDINATOR_ADDRESS": "10.0.0.2:8476"}, True),    # explicit env
     ({"JAX_NUM_PROCESSES": "4"}, True),
+    ({"JAX_NUM_PROCESSES": "1"}, False),                 # semantically single
+    ({"JAX_NUM_PROCESSES": "auto"}, True),               # malformed: fail loud
     ({"TPU_WORKER_HOSTNAMES": "t0,t1,t2,t3"}, True),         # Cloud TPU pod
     ({"TPU_WORKER_HOSTNAMES": "t0"}, False),                 # single-host slice
     ({"TPU_WORKER_HOSTNAMES": ""}, False),

@@ -15,11 +15,14 @@ import textwrap
 import numpy as np
 import pytest
 
+# One worker script serves both legs: the 2-process run (MH_DEVICES=4 per
+# process) and the single-process oracle (MH_DEVICES=8, no JAX_* env) —
+# the experiment definition cannot drift between them.
 _WORKER = textwrap.dedent("""
     import os, sys, json
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 4)
+    jax.config.update("jax_num_cpu_devices", int(os.environ["MH_DEVICES"]))
     sys.path.insert(0, __REPO__)
     from distributed_pytorch_tpu.config import LLMConfig, TrainConfig
     from distributed_pytorch_tpu.train.loop import train
@@ -55,7 +58,7 @@ def test_two_process_training_matches_single(tmp_path):
         env = dict(os.environ,
                    JAX_COORDINATOR_ADDRESS=f"localhost:{port}",
                    JAX_NUM_PROCESSES="2", JAX_PROCESS_ID=str(pid),
-                   MH_DATA=data_dir,
+                   MH_DATA=data_dir, MH_DEVICES="4",
                    PYTHONPATH=repo + os.pathsep
                    + os.environ.get("PYTHONPATH", ""))
         # workers pin their own platform/devices; drop the suite's env
@@ -69,7 +72,7 @@ def test_two_process_training_matches_single(tmp_path):
     outs = []
     try:
         for p in procs:
-            out, err = p.communicate(timeout=420)
+            out, err = p.communicate(timeout=300)
             assert p.returncode == 0, err.decode()[-2000:]
             import json
             outs.append(json.loads(out.decode().strip().splitlines()[-1]))
@@ -85,33 +88,22 @@ def test_two_process_training_matches_single(tmp_path):
     # both processes observe the same global loss trajectory...
     assert outs[0]["losses"] == outs[1]["losses"]
 
-    # ...and it equals the single-process 8-device run of the same config:
-    # the counter-based loader + GSPMD make the math process-count-invariant
-    # (the reference's +rank seed offsets cannot offer this).
-    single = subprocess.run(
-        [sys.executable, "-c", textwrap.dedent(f"""
-            import jax
-            jax.config.update("jax_platforms", "cpu")
-            jax.config.update("jax_num_cpu_devices", 8)
-            import sys, os, json
-            sys.path.insert(0, {repo!r})
-            os.environ["MH_DATA"] = {data_dir!r}
-            from distributed_pytorch_tpu.config import LLMConfig, TrainConfig
-            from distributed_pytorch_tpu.train.loop import train
-            mc = LLMConfig(vocab_size=256, block_size=32, n_embd=32,
-                           n_head=4, n_kv_heads=2, n_layer=2, up_dim=48)
-            tc = TrainConfig(dataset="synthetic",
-                             data_dir=os.environ["MH_DATA"],
-                             total_batch_size=8 * 1 * 32, batch_size=1,
-                             max_iters=3, parallelism="fsdp",
-                             save_stats=False)
-            stats = train(mc, tc, log=lambda s: None)
-            print(json.dumps(stats["train_losses"]))
-        """)],
-        capture_output=True, timeout=420,
-        env={k: v for k, v in os.environ.items()
-             if k not in ("JAX_PLATFORMS", "XLA_FLAGS")})
+    # ...and it equals the single-process 8-device run of the SAME worker
+    # script (no JAX_* env, MH_DEVICES=8): the counter-based loader + GSPMD
+    # make the math process-count-invariant (the reference's +rank seed
+    # offsets cannot offer this).
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("JAX_PLATFORMS", "XLA_FLAGS",
+                        "JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                        "JAX_PROCESS_ID")}
+    env.update(MH_DATA=data_dir, MH_DEVICES="8",
+               PYTHONPATH=repo + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    single = subprocess.run([sys.executable, str(worker)],
+                            capture_output=True, timeout=300, env=env)
     assert single.returncode == 0, single.stderr.decode()[-2000:]
     import json
     oracle = json.loads(single.stdout.decode().strip().splitlines()[-1])
-    np.testing.assert_allclose(outs[0]["losses"], oracle, rtol=2e-4)
+    assert oracle["procs"] == 1 and oracle["devices"] == 8
+    np.testing.assert_allclose(outs[0]["losses"], oracle["losses"],
+                               rtol=2e-4)

@@ -29,6 +29,11 @@ TINY_CHUNKED = dict(**TINY, loss_chunk=8)
 # pipeline parallelism (models/pipeline.py): stacked blocks over 'pipe',
 # 4 microbatches of 2 sequences through a 2-deep layer stack
 TINY_PP = dict(**TINY, pp_stages=2, pp_microbatches=4)
+# MLA under tensor parallelism: the latent up-projections (W_uq/W_uk/W_uv)
+# are column-parallel and W_o row-parallel in the TP table
+MLA = dict(vocab_size=128, block_size=32, n_embd=32, n_head=4,
+           n_kv_heads=4, n_layer=2, up_dim=64, attn="mla",
+           q_latent_dim=8, kv_latent_dim=8, rope_head_dim=4)
 
 
 def _batch(mc, accum, B, seed=0):
@@ -128,10 +133,15 @@ RECIPES = [
     # pipeline parallelism: dp=4 x pipe=2 — the interleaved schedule must
     # reproduce the oracle trajectory exactly (same stacked init)
     ("pp", TINY_PP, {"pp_size": 2}),
+    # ring attention + capacity-bounded MoE dispatch in one model: the
+    # long-context MoE configuration
+    ("fsdp", MOE_SCATTER, {"sp_size": 2}),
+    # MLA's absorbed projections under megatron-style TP
+    ("fsdp_tp", MLA, {"tp_size": 2}),
 ]
-_RECIPE_IDS = [r[0] for r in RECIPES[:-6]] + [
+_RECIPE_IDS = [r[0] for r in RECIPES[:-8]] + [
     "ep_scatter", "fsdp_x_ep", "fsdp_x_sp", "fsdp_chunked_ce",
-    "tp_chunked_ce", "pp"]
+    "tp_chunked_ce", "pp", "moe_x_sp", "mla_x_tp"]
 
 
 _ORACLE_CACHE: dict = {}

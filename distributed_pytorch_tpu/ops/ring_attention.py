@@ -14,11 +14,18 @@ recipes:
   normalizer l, f32 accumulator — the same math as the Pallas flash
   kernel, ops/flash_attention.py), then rotates k/v one hop around the
   ring with `jax.lax.ppermute` over ICI neighbors. KV chunks whose global
-  positions lie entirely in the causal future contribute zero via the
-  positional mask (compute is not skipped — a uniform schedule keeps every
-  ring hop the same length; documented 2x-FLOPs-of-optimal tradeoff).
-  Each step is wrapped in `jax.checkpoint` so the backward rematerializes
-  the per-chunk probabilities instead of storing sp O((T/sp)^2) slabs.
+  positions lie entirely in the causal future are SKIPPED with a
+  per-device `lax.cond`: a device spends no matmul FLOPs on a chunk the
+  mask would zero anyway (on average (sp-1)/2 of sp hops skip). NOTE the
+  honest accounting: under this CONTIGUOUS layout the last ring device is
+  visible on every hop and each ppermute synchronizes the ring, so step
+  *latency* stays sp x chunk_time — the cond saves energy/FLOPs and frees
+  compute for co-scheduled work, not wall-clock. Recovering latency needs
+  a load-balanced (zig-zag/striped) sequence layout where every device
+  holds one early and one late stripe — future work, it changes the
+  loader's T-sharding contract. Each step is wrapped in `jax.checkpoint`
+  so the backward rematerializes the per-chunk probabilities instead of
+  storing sp O((T/sp)^2) slabs.
 * **Ulysses**: `all_to_all` resharding (B, T/sp, H, D) -> (B, T, H/sp, D),
   ONE local full-sequence causal attention per head subset (which can use
   the Pallas flash kernel), then the inverse all_to_all. Cheaper compute
@@ -91,6 +98,15 @@ def ring_attention_local(q, k, v, *, scale: float, axis_name: str = "seq",
     acc = jnp.zeros((B, nh, Tloc, D), jnp.float32)
     m = jnp.full((B, nh, Tloc, 1), _NEG_INF, jnp.float32)
     l = jnp.zeros((B, nh, Tloc, 1), jnp.float32)
+    # mark the constant-initialized carry as device-varying over the same
+    # axes as q (whatever the enclosing shard_map made it vary over): the
+    # hop-skipping lax.cond below requires both branches to agree on
+    # varying-axis types, and the computed branch's outputs inherit the
+    # inputs' varying set
+    vma = tuple(jax.typeof(q).vma)
+    if vma:
+        acc, m, l = (jax.lax.pcast(t, vma, to="varying")
+                     for t in (acc, m, l))
 
     step_fn = jax.checkpoint(functools.partial(_chunk_update, scale=scale,
                                                causal=causal))
@@ -101,7 +117,20 @@ def ring_attention_local(q, k, v, *, scale: float, axis_name: str = "seq",
         # after s hops the resident chunk originated at ring position
         # (idx - s) mod sp
         ko = ((idx - s) % sp) * Tloc
-        carry = step_fn(carry, q, k, v, qo, ko)
+        if causal:
+            # skip chunks entirely in this device's causal future: the
+            # predicate is per-device (idx is traced) and the branches
+            # contain no collectives, so the cond is SPMD-legal inside
+            # shard_map; the ppermute below still runs every hop on every
+            # device, keeping the ring schedule uniform
+            visible = ko <= qo + Tloc - 1
+            carry = jax.lax.cond(
+                visible,
+                lambda c, *xs: step_fn(c, *xs),
+                lambda c, *xs: c,
+                carry, q, k, v, qo, ko)
+        else:
+            carry = step_fn(carry, q, k, v, qo, ko)
         if s < sp - 1:
             k = jax.lax.ppermute(k, axis_name, perm)
             v = jax.lax.ppermute(v, axis_name, perm)

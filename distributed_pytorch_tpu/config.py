@@ -219,10 +219,11 @@ class TrainConfig:
     sp_size: int = 1                 # sequence axis size (sp / ring attention)
     pp_size: int = 1                 # pipe axis size (pp; = LLMConfig.pp_stages)
     compute_dtype: str = "bfloat16"  # bf16 compute, fp32 params/opt state
-    # attention kernel choice; under the 'sp' recipe, 'auto' and 'ring'
-    # select ring attention over the 'seq' axis, 'ulysses' the all-to-all
-    # head<->sequence variant (ops/ring_attention.py)
-    attn_impl: str = "auto"  # auto | xla | pallas | naive | ring | ulysses
+    # attention kernel choice; under the 'sp' recipe, 'auto'/'zigzag'
+    # select the load-balanced zig-zag ring over the 'seq' axis, 'ring'
+    # the contiguous-layout ring, 'ulysses' the all-to-all head<->sequence
+    # variant (ops/ring_attention.py)
+    attn_impl: str = "auto"  # auto | xla | pallas | naive | ring | zigzag | ulysses
     moe_impl: str = "dense"          # 'dense' | 'scatter'
     # checkpoint/resume (exceeds reference save-only; SURVEY.md §5)
     ckpt_interval: int = 0           # 0 = end-of-run only
@@ -236,7 +237,7 @@ class TrainConfig:
         assert self.moe_impl in ("dense", "scatter"), \
             f"unknown moe_impl {self.moe_impl!r}"
         assert self.attn_impl in ("auto", "xla", "pallas", "naive", "ring",
-                                  "ulysses"), \
+                                  "zigzag", "ulysses"), \
             f"unknown attn_impl {self.attn_impl!r}"
         assert self.platform in ("auto", "tpu", "cpu"), \
             f"unknown platform {self.platform!r}"

@@ -86,6 +86,22 @@ def _chunk_update(carry, q, k, v, qo, ko, scale, causal):
     return acc, m_new, l
 
 
+def _init_carry(q, nh: int, Tq: int):
+    """Zeroed online-softmax carry (acc, m, l) for Tq query rows, pcast to
+    q's varying-axis set: the hop-skipping lax.cond requires both branches
+    to agree on varying-manual-axis types inside shard_map, and the
+    computed branch's outputs inherit the inputs' varying set."""
+    B, D = q.shape[0], q.shape[3]
+    acc = jnp.zeros((B, nh, Tq, D), jnp.float32)
+    m = jnp.full((B, nh, Tq, 1), _NEG_INF, jnp.float32)
+    l = jnp.zeros((B, nh, Tq, 1), jnp.float32)
+    vma = tuple(jax.typeof(q).vma)
+    if vma:
+        acc, m, l = (jax.lax.pcast(t, vma, to="varying")
+                     for t in (acc, m, l))
+    return acc, m, l
+
+
 def ring_attention_local(q, k, v, *, scale: float, axis_name: str = "seq",
                          sp: int, causal: bool = True) -> jnp.ndarray:
     """Ring attention body (call inside shard_map). q/k/v: local
@@ -95,18 +111,7 @@ def ring_attention_local(q, k, v, *, scale: float, axis_name: str = "seq",
     B, Tloc, nh, D = q.shape
     qo = idx * Tloc
 
-    acc = jnp.zeros((B, nh, Tloc, D), jnp.float32)
-    m = jnp.full((B, nh, Tloc, 1), _NEG_INF, jnp.float32)
-    l = jnp.zeros((B, nh, Tloc, 1), jnp.float32)
-    # mark the constant-initialized carry as device-varying over the same
-    # axes as q (whatever the enclosing shard_map made it vary over): the
-    # hop-skipping lax.cond below requires both branches to agree on
-    # varying-axis types, and the computed branch's outputs inherit the
-    # inputs' varying set
-    vma = tuple(jax.typeof(q).vma)
-    if vma:
-        acc, m, l = (jax.lax.pcast(t, vma, to="varying")
-                     for t in (acc, m, l))
+    acc, m, l = _init_carry(q, nh, Tloc)
 
     step_fn = jax.checkpoint(functools.partial(_chunk_update, scale=scale,
                                                causal=causal))
@@ -138,6 +143,82 @@ def ring_attention_local(q, k, v, *, scale: float, axis_name: str = "seq",
     acc, m, l = carry
     out = acc / jnp.maximum(l, 1e-30)
     return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def zigzag_ring_attention_local(q, k, v, *, scale: float,
+                                axis_name: str = "seq",
+                                sp: int) -> jnp.ndarray:
+    """Load-balanced ("zig-zag") causal ring attention body.
+
+    The contiguous layout's flaw: device sp-1 holds the latest positions
+    and is causally visible on every hop, so per-hop barriers pin step
+    latency at sp x chunk_time even with hop skipping. Here the sequence
+    is pre-permuted (see `zigzag_permutation`) so device i holds stripe i
+    (early) AND stripe 2sp-1-i (late), each of length T/(2sp): every
+    device's total visible work across the ring is identical
+    ((2sp+1) stripe-pairs), so the causal triangle is spread evenly and
+    wall-clock approaches the balanced optimum instead of 2x it.
+
+    Local layout: q/k/v = [stripe_lo, stripe_hi] concatenated on the
+    sequence axis. Each hop updates two (q half, kv half) carries with
+    per-pair lax.cond visibility (b <= a at stripe granularity; the
+    positional mask inside _chunk_update handles the b == a diagonal).
+    """
+    idx = jax.lax.axis_index(axis_name)
+    B, Tloc, nh, D = q.shape
+    Ts = Tloc // 2
+    a_lo = idx * Ts                      # global offset of early stripe
+    a_hi = (2 * sp - 1 - idx) * Ts       # global offset of late stripe
+    q_lo, q_hi = q[:, :Ts], q[:, Ts:]
+
+    step_fn = jax.checkpoint(functools.partial(_chunk_update, scale=scale,
+                                               causal=True))
+
+    def masked_update(carry, q_part, kv_k, kv_v, qo, ko):
+        return jax.lax.cond(
+            ko <= qo,                    # stripe-granular visibility
+            lambda c: step_fn(c, q_part, kv_k, kv_v, qo, ko),
+            lambda c: c,
+            carry)
+
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+    c_lo, c_hi = _init_carry(q, nh, Ts), _init_carry(q, nh, Ts)
+    for s in range(sp):
+        j = (idx - s) % sp               # origin device of resident kv
+        b_lo = j * Ts
+        b_hi = (2 * sp - 1 - j) * Ts
+        k_lo, k_hi = k[:, :Ts], k[:, Ts:]
+        v_lo, v_hi = v[:, :Ts], v[:, Ts:]
+        for ko, kk, vv in ((b_lo, k_lo, v_lo), (b_hi, k_hi, v_hi)):
+            c_lo = masked_update(c_lo, q_lo, kk, vv, a_lo, ko)
+            c_hi = masked_update(c_hi, q_hi, kk, vv, a_hi, ko)
+        if s < sp - 1:
+            k = jax.lax.ppermute(k, axis_name, perm)
+            v = jax.lax.ppermute(v, axis_name, perm)
+
+    def finish(carry):
+        acc, m, l = carry
+        out = acc / jnp.maximum(l, 1e-30)
+        return jnp.einsum("bhqd->bqhd", out)
+
+    return jnp.concatenate([finish(c_lo), finish(c_hi)],
+                           axis=1).astype(q.dtype)
+
+
+def zigzag_permutation(T: int, sp: int):
+    """(perm, inv_perm) index arrays mapping natural sequence order to the
+    zig-zag shard layout: shard i's rows = [stripe_i, stripe_{2sp-1-i}],
+    stripe length T/(2sp)."""
+    import numpy as np
+    Ts = T // (2 * sp)
+    parts = []
+    for i in range(sp):
+        parts.append(np.arange(i * Ts, (i + 1) * Ts))
+        parts.append(np.arange((2 * sp - 1 - i) * Ts, (2 * sp - i) * Ts))
+    perm = np.concatenate(parts)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(T)
+    return perm, inv
 
 
 def ulysses_attention_local(q, k, v, *, scale: float, axis_name: str = "seq",
@@ -180,6 +261,7 @@ def sp_sdpa(q, k, v, *, scale: float, causal: bool = True,
         "sequence-parallel attention requires q and kv of equal length "
         f"(got {q.shape[1]} vs {k.shape[1]})")
 
+    zigzag = False
     if impl == "ulysses":
         nkv = k.shape[2]
         assert q.shape[2] % sp == 0 and nkv % sp == 0, (
@@ -187,6 +269,14 @@ def sp_sdpa(q, k, v, *, scale: float, causal: bool = True,
             f"n_kv_heads={nkv}; use ring attention instead")
         body = functools.partial(ulysses_attention_local, scale=scale,
                                  sp=sp, causal=causal, attn_impl=attn_impl)
+    elif impl == "zigzag" and causal and q.shape[1] % (2 * sp) == 0:
+        # load-balanced zig-zag ring (latency ~optimal; see the local fn's
+        # docstring) — semantically identical to the contiguous ring. The
+        # dispatcher's 'auto' resolves here; an explicit impl='ring' keeps
+        # the contiguous schedule reachable for A/B and debugging.
+        zigzag = True
+        body = functools.partial(zigzag_ring_attention_local, scale=scale,
+                                 sp=sp)
     else:
         body = functools.partial(ring_attention_local, scale=scale, sp=sp,
                                  causal=causal)
@@ -198,4 +288,8 @@ def sp_sdpa(q, k, v, *, scale: float, causal: bool = True,
     spec = P("data", "seq", None, None)
     fn = jax.shard_map(shard_body, mesh=mesh,
                        in_specs=(spec, spec, spec), out_specs=spec)
+    if zigzag:
+        perm, inv = zigzag_permutation(q.shape[1], sp)
+        out = fn(q[:, perm], k[:, perm], v[:, perm])
+        return out[:, inv]
     return fn(q, k, v)

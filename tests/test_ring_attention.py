@@ -25,7 +25,7 @@ def mesh24():
     return build_mesh(MeshPlan(data=2, seq=4))
 
 
-@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+@pytest.mark.parametrize("impl", ["ring", "zigzag", "ulysses"])
 def test_sp_matches_full_attention(mesh24, impl):
     B, T, nh, hs = 4, 128, 4, 16
     q, k, v = rand_qkv(jax.random.PRNGKey(0), B, T, nh, nh, hs)
@@ -50,14 +50,17 @@ def test_ring_gqa(mesh24):
                                rtol=2e-5, atol=2e-5)
 
 
-def test_ring_gradients_match(mesh24):
+@pytest.mark.parametrize("impl", ["ring", "zigzag"])
+def test_ring_gradients_match(mesh24, impl):
+    """Both ring schedules (contiguous with hop-skipping cond, and the
+    load-balanced zig-zag) must backprop identically to the oracle."""
     B, T, nh, hs = 2, 64, 4, 16
     q, k, v = rand_qkv(jax.random.PRNGKey(2), B, T, nh, nh, hs)
     scale = 1.0 / hs ** 0.5
     w = jax.random.normal(jax.random.PRNGKey(3), q.shape)
 
     def loss_ring(q, k, v):
-        return jnp.sum(sp_sdpa(q, k, v, scale=scale, impl="ring") * w)
+        return jnp.sum(sp_sdpa(q, k, v, scale=scale, impl=impl) * w)
 
     def loss_ref(q, k, v):
         return jnp.sum(_naive_sdpa(q, k, v, scale=scale, q_offset=0,
@@ -168,3 +171,66 @@ def test_ring_without_mesh_fails_loudly():
     kv = jnp.zeros((2, 32, 4, 8))
     out = sdpa(q[:, :1], kv, kv, impl="ring", q_offset=31)
     assert out.shape == (2, 1, 4, 8)
+
+
+def test_zigzag_permutation_roundtrip():
+    from distributed_pytorch_tpu.ops.ring_attention import zigzag_permutation
+    perm, inv = zigzag_permutation(32, 4)
+    assert sorted(perm.tolist()) == list(range(32))
+    assert (perm[inv] == np.arange(32)).all()
+    # shard 0 holds stripe 0 (earliest) and stripe 7 (latest)
+    assert perm[:4].tolist() == [0, 1, 2, 3]
+    assert perm[4:8].tolist() == [28, 29, 30, 31]
+
+
+def test_zigzag_matches_contiguous_ring():
+    """Zig-zag is a pure re-scheduling: same output as the contiguous ring
+    (and hence as full attention) to numerical tolerance."""
+    from distributed_pytorch_tpu.ops.ring_attention import (
+        ring_attention_local, zigzag_ring_attention_local,
+        zigzag_permutation)
+    from distributed_pytorch_tpu.parallel.mesh import build_mesh, resolve_plan
+    from jax.sharding import PartitionSpec as P
+
+    B, T, H, D, sp = 2, 32, 4, 8, 4
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(ks[0], (B, T, H, D))
+    k = jax.random.normal(ks[1], (B, T, H, D))
+    v = jax.random.normal(ks[2], (B, T, H, D))
+    mesh = build_mesh(resolve_plan("sp", 8, sp_size=sp))
+    spec = P("data", "seq", None, None)
+    scale = 1.0 / D ** 0.5
+
+    import functools
+    ring = jax.shard_map(
+        functools.partial(ring_attention_local, scale=scale, sp=sp),
+        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)(q, k, v)
+
+    perm, inv = zigzag_permutation(T, sp)
+    zz = jax.shard_map(
+        functools.partial(zigzag_ring_attention_local, scale=scale, sp=sp),
+        mesh=mesh, in_specs=(spec,) * 3,
+        out_specs=spec)(q[:, perm], k[:, perm], v[:, perm])[:, inv]
+
+    np.testing.assert_allclose(np.asarray(zz), np.asarray(ring),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_ring_fallback_when_stripes_dont_divide():
+    """T not divisible by 2*sp: sp_sdpa silently uses the contiguous ring
+    and still matches full attention."""
+    from distributed_pytorch_tpu.ops.attention_core import sdpa
+    from distributed_pytorch_tpu.parallel import context
+    from distributed_pytorch_tpu.parallel.mesh import build_mesh, resolve_plan
+
+    B, T, H, D, sp = 2, 24, 4, 8, 4   # 24 % 8 != 0 -> contiguous
+    ks = jax.random.split(jax.random.PRNGKey(1), 3)
+    q = jax.random.normal(ks[0], (B, T, H, D))
+    k = jax.random.normal(ks[1], (B, T, H, D))
+    v = jax.random.normal(ks[2], (B, T, H, D))
+    oracle = sdpa(q, k, v, impl="naive")
+    mesh = build_mesh(resolve_plan("sp", 8, sp_size=sp))
+    with context.use_mesh(mesh):
+        got = sdpa(q, k, v, impl="ring")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(oracle),
+                               rtol=2e-5, atol=2e-6)

@@ -12,8 +12,11 @@ reference logs only ms/step + reserved GB, train.py:354-359).
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import os
+import signal
+import threading
 import time
 from typing import Any, Callable, Optional
 
@@ -148,6 +151,56 @@ def _data_paths(train_cfg: TrainConfig, vocab_size: int) -> tuple[str, str]:
     return train_bin, val_bin
 
 
+@contextlib.contextmanager
+def _graceful_stop(say):
+    """Preemption-safe shutdown (SURVEY §5: the reference has no failure
+    handling at all — torchrun without --max-restarts, no signal handling).
+    On SIGTERM — what Cloud TPU preemptible/spot VMs send before reclaim —
+    set a flag the training loop checks (and AGREES on across processes,
+    see _agree_stop) at the top of each iteration, where it writes a
+    checkpoint and exits cleanly; with `--resume` the next run continues
+    the exact stream. Installed only from the main thread (signal API
+    constraint); restores the previous handler on exit.
+
+    The handler body ONLY sets a flag: calling print/log from a signal
+    handler can re-enter a locked stdout buffer mid-write and raise
+    RuntimeError in the main thread — the loop logs the event instead."""
+    stop = {"flag": False}
+    prev = None
+    installed = False
+    if threading.current_thread() is threading.main_thread():
+        def _handler(signum, frame):
+            stop["flag"] = True
+        try:
+            prev = signal.signal(signal.SIGTERM, _handler)
+            installed = True
+        except ValueError:  # pragma: no cover - embedded interpreters
+            pass
+    try:
+        yield stop
+    finally:
+        # prev is None when the previous handler was installed from C
+        # (not inspectable from Python) — leave ours in place then
+        if installed and prev is not None:
+            signal.signal(signal.SIGTERM, prev)
+
+
+def _agree_stop(local_flag: bool) -> bool:
+    """Cross-process agreement on the preemption flag: only the SIGTERM'd
+    host sees it locally, but every control-flow divergence on a pod —
+    skipping an eval, entering the checkpoint save (an orbax cross-process
+    collective), breaking the loop — must happen on ALL processes in the
+    same iteration or the slice deadlocks on mismatched collectives. A
+    tiny allgather-any per iteration buys that agreement; single-process
+    runs skip it entirely."""
+    if jax.process_count() == 1:
+        return local_flag
+    from jax.experimental import multihost_utils
+    flags = multihost_utils.process_allgather(
+        np.asarray([local_flag], dtype=np.bool_))
+    return bool(np.asarray(flags).any())
+
+
 def estimate_loss(eval_step, state, loaders: dict, eval_iters: int) -> dict:
     """Mean eval loss over eval_iters batches per split (reference
     estimate_loss, single-gpu/train.py:280-293). Eval batches are keyed on
@@ -272,63 +325,80 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
     x, y = train_loader.next_batch(step=start_step)
     pending: list = []                         # metric futures since last sync
     win_t0 = time.perf_counter()
-    for it in range(start_step, train_cfg.max_iters + 1):
-        if train_cfg.eval and it % train_cfg.eval_interval == 0:
-            t0 = time.perf_counter()
-            ev = estimate_loss(eval_step, state,
-                               {"train": eval_train_loader,
-                                "val": val_loader},
-                               train_cfg.eval_iters)
-            stats["val_losses"].append((it, ev["val"]))
-            say(f"iter {it}: train {ev['train']:.4f} val {ev['val']:.4f} "
-                f"({time.perf_counter() - t0:.1f}s)")
-            win_t0 = time.perf_counter()       # eval time isn't step time
+    with _graceful_stop(say) as stop:
+        for it in range(start_step, train_cfg.max_iters + 1):
+            if _agree_stop(stop["flag"]):
+                # preemption: drain queued metrics, checkpoint the state as
+                # of the last completed step, exit before spending grace
+                # time on eval or another step
+                if pending:
+                    for g in jax.device_get(pending):
+                        stats["train_losses"].append(float(g["loss"]))
+                    pending.clear()
+                step_now = int(jax.device_get(state.step))
+                path = ckpt.save_checkpoint(
+                    os.path.join(ckpt_root, f"step_{step_now}"), state,
+                    model_cfg, train_cfg)
+                say(f"[signal] SIGTERM: checkpoint -> {path}; stopping at "
+                    f"iter {it} (resume with --resume)")
+                break
 
-        state, m = train_step(state, x, y)
-        pending.append(m)
-        if it < train_cfg.max_iters:  # no wasted sample on the final iter
-            x, y = train_loader.next_batch(step=it + 1)  # host prefetch while device runs
+            if train_cfg.eval and it % train_cfg.eval_interval == 0:
+                t0 = time.perf_counter()
+                ev = estimate_loss(eval_step, state,
+                                   {"train": eval_train_loader,
+                                    "val": val_loader},
+                                   train_cfg.eval_iters)
+                stats["val_losses"].append((it, ev["val"]))
+                say(f"iter {it}: train {ev['train']:.4f} val {ev['val']:.4f} "
+                    f"({time.perf_counter() - t0:.1f}s)")
+                win_t0 = time.perf_counter()       # eval time isn't step time
 
-        ckpt_due = (train_cfg.ckpt_interval and it
-                    and it % train_cfg.ckpt_interval == 0)
-        eval_next = (train_cfg.eval
-                     and (it + 1) % train_cfg.eval_interval == 0)
-        sync_due = (it % train_cfg.log_interval == 0 or ckpt_due
-                    or eval_next or it == train_cfg.max_iters)
-        if sync_due:
-            got = jax.device_get(pending)      # blocks on all queued steps
-            t_now = time.perf_counter()
-            dt = (t_now - win_t0) / len(pending)
-            win_t0 = t_now
-            first_window = not stats["train_losses"]
-            for g in got:
-                stats["train_losses"].append(float(g["loss"]))
-            pending.clear()
-            if not first_window:               # first window includes compile
-                for _ in got:
-                    stats["step_times"].append(dt)
-                    stats["tokens_per_sec"].append(tokens_per_step / dt)
-                    if peak:
-                        stats["mfu"].append(
-                            flops_per_step / dt / (peak * n_chips))
-            if it % train_cfg.log_interval == 0:
-                loss = stats["train_losses"][-1]
-                tps = tokens_per_step / dt
-                mfu_s = (f" | mfu "
-                         f"{flops_per_step / dt / (peak * n_chips):6.2%}"
-                         if peak else "")
-                hbm = M.device_memory_gb()  # reference reserved-GB print,
-                hbm_s = f" | hbm {hbm:5.2f}GB" if hbm else ""  # train.py:356
-                say(f"iter {it:5d} | loss {loss:.4f} | "
-                    f"dt {dt * 1e3:7.1f}ms | "
-                    f"tok/s/chip {tps / n_chips:10.0f}{mfu_s}{hbm_s}")
+            state, m = train_step(state, x, y)
+            pending.append(m)
+            if it < train_cfg.max_iters:  # no wasted sample on the final iter
+                x, y = train_loader.next_batch(step=it + 1)  # host prefetch while device runs
 
-        if ckpt_due:
-            path = ckpt.save_checkpoint(
-                os.path.join(ckpt_root, f"step_{it}"), state,
-                model_cfg, train_cfg)
-            say(f"checkpoint -> {path}")
-            win_t0 = time.perf_counter()       # ckpt time isn't step time
+            ckpt_due = bool(train_cfg.ckpt_interval and it
+                            and it % train_cfg.ckpt_interval == 0)
+            eval_next = (train_cfg.eval
+                         and (it + 1) % train_cfg.eval_interval == 0)
+            sync_due = (it % train_cfg.log_interval == 0 or ckpt_due
+                        or eval_next or it == train_cfg.max_iters)
+            if sync_due:
+                got = jax.device_get(pending)      # blocks on all queued steps
+                t_now = time.perf_counter()
+                dt = (t_now - win_t0) / len(pending)
+                win_t0 = t_now
+                first_window = not stats["train_losses"]
+                for g in got:
+                    stats["train_losses"].append(float(g["loss"]))
+                pending.clear()
+                if not first_window:               # first window includes compile
+                    for _ in got:
+                        stats["step_times"].append(dt)
+                        stats["tokens_per_sec"].append(tokens_per_step / dt)
+                        if peak:
+                            stats["mfu"].append(
+                                flops_per_step / dt / (peak * n_chips))
+                if it % train_cfg.log_interval == 0:
+                    loss = stats["train_losses"][-1]
+                    tps = tokens_per_step / dt
+                    mfu_s = (f" | mfu "
+                             f"{flops_per_step / dt / (peak * n_chips):6.2%}"
+                             if peak else "")
+                    hbm = M.device_memory_gb()  # reference reserved-GB print,
+                    hbm_s = f" | hbm {hbm:5.2f}GB" if hbm else ""  # train.py:356
+                    say(f"iter {it:5d} | loss {loss:.4f} | "
+                        f"dt {dt * 1e3:7.1f}ms | "
+                        f"tok/s/chip {tps / n_chips:10.0f}{mfu_s}{hbm_s}")
+
+            if ckpt_due:
+                path = ckpt.save_checkpoint(
+                    os.path.join(ckpt_root, f"step_{it}"), state,
+                    model_cfg, train_cfg)
+                say(f"checkpoint -> {path}")
+                win_t0 = time.perf_counter()       # ckpt time isn't step time
 
     if train_cfg.profile and is_main:
         jax.profiler.stop_trace()

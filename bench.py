@@ -75,6 +75,14 @@ def run_bench(platform: str, only_recipe: str | None = None) -> dict:
         except RuntimeError:
             pass  # backend already initialized as cpu
 
+    try:
+        # persistent compile cache: repeat bench invocations (driver reruns,
+        # the dp leg after fsdp) skip the 20-40s XLA compile
+        jax.config.update("jax_compilation_cache_dir", "/tmp/jax_ccache")
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+    except Exception:
+        pass
+
     from distributed_pytorch_tpu.config import LLMConfig, TrainConfig
     from distributed_pytorch_tpu.train.loop import train
 

@@ -34,23 +34,59 @@ def _abs(path: str) -> str:
     return os.path.abspath(os.path.expanduser(path))
 
 
+def _write_meta(path: str, state: TrainState, model_cfg, train_cfg) -> None:
+    if model_cfg is None:
+        return
+    meta = {
+        "model_config": dataclasses.asdict(model_cfg),
+        "train_config": dataclasses.asdict(train_cfg) if train_cfg else {},
+        "step": int(jax.device_get(state.step)),
+    }
+    if jax.process_index() == 0:
+        with open(os.path.join(path, "config.json"), "w") as f:
+            json.dump(meta, f, indent=2)
+
+
 def save_checkpoint(path: str, state: TrainState,
                     model_cfg: Optional[LLMConfig] = None,
                     train_cfg: Optional[TrainConfig] = None) -> str:
-    """Write `state` (sharded) + configs (json) under `path`."""
+    """Write `state` (sharded) + configs (json) under `path`. Blocks until
+    the save is durable — use for final/preemption saves."""
     path = _abs(path)
     with ocp.StandardCheckpointer() as ckptr:
         ckptr.save(os.path.join(path, "state"), state, force=True)
-    if model_cfg is not None:
-        meta = {
-            "model_config": dataclasses.asdict(model_cfg),
-            "train_config": dataclasses.asdict(train_cfg) if train_cfg else {},
-            "step": int(jax.device_get(state.step)),
-        }
-        if jax.process_index() == 0:
-            with open(os.path.join(path, "config.json"), "w") as f:
-                json.dump(meta, f, indent=2)
+    _write_meta(path, state, model_cfg, train_cfg)
     return path
+
+
+_async_ckptr: Optional[ocp.AsyncCheckpointer] = None
+
+
+def save_checkpoint_async(path: str, state: TrainState,
+                          model_cfg: Optional[LLMConfig] = None,
+                          train_cfg: Optional[TrainConfig] = None) -> str:
+    """Non-blocking interval save: device buffers are snapshotted, the
+    serialization runs on background threads, and training continues —
+    the reference's (dead-coded) saves all block (kaggle-fsdp.py:1141).
+    Any in-flight previous save is waited on first (bounds host memory to
+    one outstanding snapshot); call `wait_for_saves()` before process
+    exit. Orbax finalizes atomically, so `latest_step_dir` never sees a
+    torn checkpoint."""
+    global _async_ckptr
+    if _async_ckptr is None:
+        _async_ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+    _async_ckptr.wait_until_finished()
+    path = _abs(path)
+    _async_ckptr.save(os.path.join(path, "state"),
+                      args=ocp.args.StandardSave(state), force=True)
+    _write_meta(path, state, model_cfg, train_cfg)
+    return path
+
+
+def wait_for_saves() -> None:
+    """Block until all async interval saves are durable."""
+    if _async_ckptr is not None:
+        _async_ckptr.wait_until_finished()
 
 
 def load_configs(path: str) -> tuple[LLMConfig, TrainConfig, int]:
@@ -114,13 +150,19 @@ def restore_for_inference(path: str, abstract_state: Any,
 
 
 def latest_step_dir(root: str) -> Optional[str]:
-    """Find the newest `step_*` checkpoint dir under root, if any."""
+    """Find the newest COMPLETE `step_*` checkpoint dir under root.
+
+    A dir whose orbax `state/` subdir never finalized (crash between an
+    async save's dispatch and its background commit — config.json is
+    written eagerly) is skipped, so --resume falls back to the previous
+    durable checkpoint instead of crashing on a torn one."""
     root = _abs(root)
     if not os.path.isdir(root):
         return None
     steps = []
     for name in os.listdir(root):
-        if name.startswith("step_") and name[5:].isdigit():
+        if name.startswith("step_") and name[5:].isdigit() \
+                and os.path.isdir(os.path.join(root, name, "state")):
             steps.append(int(name[5:]))
     if not steps:
         return None

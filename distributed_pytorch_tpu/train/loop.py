@@ -152,7 +152,7 @@ def _data_paths(train_cfg: TrainConfig, vocab_size: int) -> tuple[str, str]:
 
 
 @contextlib.contextmanager
-def _graceful_stop(say):
+def _graceful_stop():
     """Preemption-safe shutdown (SURVEY §5: the reference has no failure
     handling at all — torchrun without --max-restarts, no signal handling).
     On SIGTERM — what Cloud TPU preemptible/spot VMs send before reclaim —
@@ -325,9 +325,19 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
     x, y = train_loader.next_batch(step=start_step)
     pending: list = []                         # metric futures since last sync
     win_t0 = time.perf_counter()
-    with _graceful_stop(say) as stop:
+    stopped_early = False
+    with _graceful_stop() as stop:
         for it in range(start_step, train_cfg.max_iters + 1):
-            if _agree_stop(stop["flag"]):
+            # Preemption checks happen at DETERMINISTIC boundaries (every
+            # process computes the same schedule from it/config): on pods
+            # _agree_stop is a collective, and running it every iteration
+            # would re-serialize the async step pipeline this loop exists
+            # to avoid. Worst-case reaction latency = log_interval steps.
+            check_due = (it == start_step
+                         or it % train_cfg.log_interval == 0
+                         or (train_cfg.eval
+                             and it % train_cfg.eval_interval == 0))
+            if check_due and _agree_stop(stop["flag"]):
                 # preemption: drain queued metrics, checkpoint the state as
                 # of the last completed step, exit before spending grace
                 # time on eval or another step
@@ -336,11 +346,13 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
                         stats["train_losses"].append(float(g["loss"]))
                     pending.clear()
                 step_now = int(jax.device_get(state.step))
+                ckpt.wait_for_saves()  # in-flight async save first
                 path = ckpt.save_checkpoint(
                     os.path.join(ckpt_root, f"step_{step_now}"), state,
                     model_cfg, train_cfg)
                 say(f"[signal] SIGTERM: checkpoint -> {path}; stopping at "
                     f"iter {it} (resume with --resume)")
+                stopped_early = True
                 break
 
             if train_cfg.eval and it % train_cfg.eval_interval == 0:
@@ -394,16 +406,22 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
                         f"tok/s/chip {tps / n_chips:10.0f}{mfu_s}{hbm_s}")
 
             if ckpt_due:
-                path = ckpt.save_checkpoint(
+                # interval saves are async: serialization overlaps the next
+                # steps instead of stalling them (train/checkpoint.py)
+                path = ckpt.save_checkpoint_async(
                     os.path.join(ckpt_root, f"step_{it}"), state,
                     model_cfg, train_cfg)
-                say(f"checkpoint -> {path}")
+                say(f"checkpoint (async) -> {path}")
                 win_t0 = time.perf_counter()       # ckpt time isn't step time
 
     if train_cfg.profile and is_main:
         jax.profiler.stop_trace()
 
-    if train_cfg.save_model:
+    ckpt.wait_for_saves()  # async interval saves must be durable
+
+    # the preemption branch already wrote this exact state; a second
+    # blocking save would burn the remaining grace period on redundant I/O
+    if train_cfg.save_model and not stopped_early:
         final = int(jax.device_get(state.step))
         path = ckpt.save_checkpoint(
             os.path.join(ckpt_root, f"step_{final}"), state,

@@ -174,9 +174,15 @@ def flagship_gpt124m(**overrides) -> "LLMConfig":
     star; the config the reference's single-gpu/train.sh trains at
     block_size 1024). One definition shared by bench.py, the MFU sweep and
     profiler scripts, and the driver entry — so every measurement measures
-    the same model."""
+    the same model.
+
+    up_dim is 2048, not GPT-2's 3072: with the gated swiglu FFN the fused
+    up projection is (C, 2*up_dim), so 2048 reproduces exactly GPT-2's
+    4.7M FFN params/layer (the standard 2/3 scaling) and the model is a
+    true ~124M. Rounds 1-3 benched up_dim=3072 (a 152M model labeled
+    124M); MFU — the headline metric — is size-normalized either way."""
     base = dict(vocab_size=50304, block_size=1024, n_embd=768, n_head=12,
-                n_kv_heads=12, attn="mha", n_layer=12, up_dim=3072,
+                n_kv_heads=12, attn="mha", n_layer=12, up_dim=2048,
                 non_linearity="swiglu", pos_emb="rope")
     base.update(overrides)
     return LLMConfig(**base)

@@ -86,14 +86,25 @@ def _dot_t(a, b):
                                preferred_element_type=jnp.float32)
 
 
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying `like`'s varying-manual-axes set: pallas
+    calls inside shard_map (the ring-attention hop path) must declare how
+    their outputs vary across mesh axes."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma is None:  # jax without vma tracking
+        return jax.ShapeDtypeStruct(shape, dtype)
+    return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, scale, block_q, block_k):
+                *, scale, block_q, block_k, causal):
     i, j = pl.program_id(2), pl.program_id(3)
-    last_j = _last_visible_kv(i, block_q, block_k)
+    last_j = _last_visible_kv(i, block_q, block_k) if causal \
+        else pl.num_programs(3) - 1
 
     @pl.when(j == 0)
     def _():
@@ -108,7 +119,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         # slow fp32 MXU passes
         q, k, v = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0]
         s = _dot(q, k, trans_b=True) * scale             # (bq, bk) f32
-        s = _mask_scores(s, i, j, block_q, block_k)
+        if causal:
+            s = _mask_scores(s, i, j, block_q, block_k)
         m_prev, l_prev = m_ref[:], l_ref[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
@@ -124,7 +136,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         lse_ref[0, 0] = m_ref[:] + jnp.log(l_safe)
 
 
-def _fwd(q, k, v, scale, block_q, block_k, interpret):
+def _fwd(q, k, v, scale, block_q, block_k, interpret, causal=True):
     """q (B,H,T,D), k/v (B,Hkv,S,D), Hkv | H -> out (B,H,T,D), lse (B,H,T,1)."""
     B, H, T, D = q.shape
     S = k.shape[2]
@@ -135,12 +147,14 @@ def _fwd(q, k, v, scale, block_q, block_k, interpret):
         # GQA: query head h reads kv head h // rep — no materialized repeat.
         # Skipped upper-triangle tiles clamp to the causal frontier so the
         # revolving-buffer DMA sees an unchanged index (no fetch).
+        if not causal:
+            return (b, h // rep, j, 0)
         return (b, h // rep,
                 jnp.minimum(j, _last_visible_kv(i, block_q, block_k)), 0)
 
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, block_q=block_q,
-                          block_k=block_k),
+                          block_k=block_k, causal=causal),
         grid=(B, H, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
@@ -155,8 +169,8 @@ def _fwd(q, k, v, scale, block_q, block_k, interpret):
             pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
-            jax.ShapeDtypeStruct((B, H, T, 1), jnp.float32),
+            _sds((B, H, T, D), q.dtype, q),
+            _sds((B, H, T, 1), jnp.float32, q),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
@@ -174,9 +188,10 @@ def _fwd(q, k, v, scale, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_acc, *, scale, block_q, block_k):
+                   dq_acc, *, scale, block_q, block_k, causal):
     i, j = pl.program_id(2), pl.program_id(3)
-    last_j = _last_visible_kv(i, block_q, block_k)
+    last_j = _last_visible_kv(i, block_q, block_k) if causal \
+        else pl.num_programs(3) - 1
 
     @pl.when(j == 0)
     def _():
@@ -186,7 +201,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _():
         q, k, v, do = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0], do_ref[0, 0]
         s = _dot(q, k, trans_b=True) * scale
-        s = _mask_scores(s, i, j, block_q, block_k)
+        if causal:
+            s = _mask_scores(s, i, j, block_q, block_k)
         p = jnp.exp(s - lse_ref[0, 0])                  # (bq, bk) f32
         dp = _dot(do, v, trans_b=True)
         ds = p * (dp - delta_ref[0, 0])
@@ -199,9 +215,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale, block_q,
-                    block_k):
+                    block_k, causal):
     j, i = pl.program_id(2), pl.program_id(3)
-    first_i = _first_visible_q(j, block_q, block_k)
+    first_i = _first_visible_q(j, block_q, block_k) if causal else 0
 
     @pl.when(i == 0)
     def _():
@@ -212,7 +228,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _():
         q, k, v, do = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0], do_ref[0, 0]
         s = _dot(q, k, trans_b=True) * scale            # (bq, bk) f32
-        s = _mask_scores(s, i, j, block_q, block_k)
+        if causal:
+            s = _mask_scores(s, i, j, block_q, block_k)
         p = jnp.exp(s - lse_ref[0, 0])
         dv_acc[:] = dv_acc[:] + _dot_t(p.astype(do.dtype), do)
         dp = _dot(do, v, trans_b=True)
@@ -225,7 +242,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd(scale, block_q, block_k, interpret, res, do):
+def _bwd_impl(scale, block_q, block_k, interpret, causal, res, do,
+              dlse=None):
+    """Shared backward: dlse (B,H,T,1) is the cotangent of the logsumexp
+    output when the caller differentiates through it (the ring merge does;
+    plain flash_attention passes None). Math: with L = sum(do*out) +
+    sum(dlse*lse), ds = p * (dp - delta + dlse) — i.e. dlse just shifts
+    the per-row delta term, since d lse/d s_j = p_j."""
     q, k, v, out, lse = res
     B, H, T, D = q.shape
     S, Hkv = k.shape[2], k.shape[1]
@@ -233,8 +256,12 @@ def _bwd(scale, block_q, block_k, interpret, res, do):
     nq, nk = T // block_q, S // block_k
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1, keepdims=True)                     # (B,H,T,1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
 
     def kv_idx(b, h, i, j):
+        if not causal:
+            return (b, h // rep, j, 0)
         return (b, h // rep,
                 jnp.minimum(j, _last_visible_kv(i, block_q, block_k)), 0)
 
@@ -243,7 +270,7 @@ def _bwd(scale, block_q, block_k, interpret, res, do):
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, block_q=block_q,
-                          block_k=block_k),
+                          block_k=block_k, causal=causal),
         grid=(B, H, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, D), q_row),
@@ -254,7 +281,7 @@ def _bwd(scale, block_q, block_k, interpret, res, do):
             pl.BlockSpec((1, 1, block_q, 1), q_row),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, D), q_row),
-        out_shape=jax.ShapeDtypeStruct((B, H, T, D), q.dtype),
+        out_shape=_sds((B, H, T, D), q.dtype, q),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         compiler_params=_SEMANTICS,
         interpret=interpret,
@@ -263,6 +290,8 @@ def _bwd(scale, block_q, block_k, interpret, res, do):
     def q_idx(b, h, j, i):
         # clamp sub-frontier q tiles (skipped compute) to an already-visible
         # index so no fresh DMA is issued
+        if not causal:
+            return (b, h, i, 0)
         return (b, h, jnp.maximum(i, _first_visible_q(j, block_q, block_k)),
                 0)
 
@@ -271,7 +300,7 @@ def _bwd(scale, block_q, block_k, interpret, res, do):
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
-                          block_k=block_k),
+                          block_k=block_k, causal=causal),
         grid=(B, H, nk, nq),
         in_specs=[
             pl.BlockSpec((1, 1, block_q, D), q_idx),
@@ -289,8 +318,8 @@ def _bwd(scale, block_q, block_k, interpret, res, do):
             pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, S, D), k.dtype),
-            jax.ShapeDtypeStruct((B, H, S, D), v.dtype),
+            _sds((B, H, S, D), k.dtype, q),
+            _sds((B, H, S, D), v.dtype, q),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),
@@ -306,18 +335,28 @@ def _bwd(scale, block_q, block_k, interpret, res, do):
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, scale, block_q, block_k, interpret):
-    out, _ = _fwd(q, k, v, scale, block_q, block_k, interpret)
-    return out
+# One custom_vjp serves both public entries: (out, lse) with the lse
+# output differentiable (the ring merge needs d/dlse; when a caller
+# ignores lse, jax hands back a zero cotangent and the backward reduces
+# to plain FlashAttention-2).
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_lse(q, k, v, scale, block_q, block_k, interpret, causal):
+    return _fwd(q, k, v, scale, block_q, block_k, interpret, causal)
 
 
-def _flash_fwd(q, k, v, scale, block_q, block_k, interpret):
-    out, lse = _fwd(q, k, v, scale, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+def _flash_lse_fwd(q, k, v, scale, block_q, block_k, interpret, causal):
+    out, lse = _fwd(q, k, v, scale, block_q, block_k, interpret, causal)
+    return (out, lse), (q, k, v, out, lse)
 
 
-_flash.defvjp(_flash_fwd, _bwd)
+def _flash_lse_bwd(scale, block_q, block_k, interpret, causal, res, cts):
+    do, dlse = cts
+    return _bwd_impl(scale, block_q, block_k, interpret, causal, res, do,
+                     dlse=dlse)
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
 # ---------------------------------------------------------------------------
@@ -333,9 +372,8 @@ def _pick_block(n: int, preferred: int) -> int:
 
 
 def flash_attention_usable(q, k, v, *, causal: bool = True) -> bool:
-    """Static gate for the dispatcher: shapes/dtypes this kernel handles."""
-    if not causal:
-        return False
+    """Static gate for the dispatcher: shapes/dtypes this kernel handles
+    (causal and full attention both supported since round 4)."""
     B, T, nh, hs = q.shape
     S = k.shape[1]
     if q.dtype not in (jnp.float32, jnp.bfloat16):
@@ -348,21 +386,19 @@ def flash_attention_usable(q, k, v, *, causal: bool = True) -> bool:
                 and _pick_block(S, DEFAULT_BLOCK_K))
 
 
-def flash_attention(q, k, v, *, scale: float, causal: bool = True,
-                    q_offset=0, block_q: int = 0, block_k: int = 0,
-                    interpret: bool = False) -> jnp.ndarray:
-    """Causal flash attention over BTNH-layout tensors.
+def flash_attention_lse(q, k, v, *, scale: float, causal: bool = True,
+                        block_q: int = 0, block_k: int = 0,
+                        interpret: bool = False):
+    """Flash attention returning (out, lse) over BTNH-layout tensors.
 
-    q: (B, T, nh, hs); k, v: (B, S, nkv, hs) with nkv | nh. `q_offset`
-    must be a static 0 (prefill/training; the dispatcher routes
-    cached-decode offsets — including traced ones — to the naive path).
-    GQA kv heads are shared via the kernel's index maps; K/V are never
-    materialized per query head.
+    out: (B, T, nh, hs); lse: (B, T, nh) f32 logsumexp of the scaled
+    scores — DIFFERENTIABLE (custom vjp folds d/dlse into the delta
+    term). This is the building block for ring attention's cross-chunk
+    online-softmax merge (ops/ring_attention.py): each chunk contributes
+    a normalized partial (out_c, lse_c) pair and the merge is plain jnp.
+    `causal=False` computes full (unmasked) attention — the visible
+    off-diagonal chunks of a causal ring.
     """
-    assert causal, "flash kernel is causal-only; use impl='xla' otherwise"
-    assert isinstance(q_offset, int) and q_offset == 0, (
-        "flash kernel requires a static q_offset == 0; cached-decode "
-        "offsets must use the naive path")
     B, T, nh, hs = q.shape
     S, nkv = k.shape[1], k.shape[2]
     assert hs % 8 == 0, "head dim must be a multiple of 8 (sublane)"
@@ -378,5 +414,27 @@ def flash_attention(q, k, v, *, scale: float, causal: bool = True,
     qt = jnp.transpose(q, (0, 2, 1, 3))
     kt = jnp.transpose(k, (0, 2, 1, 3))
     vt = jnp.transpose(v, (0, 2, 1, 3))
-    out = _flash(qt, kt, vt, float(scale), block_q, block_k, interpret)
-    return jnp.transpose(out, (0, 2, 1, 3))
+    out, lse = _flash_lse(qt, kt, vt, float(scale), block_q, block_k,
+                          interpret, causal)
+    return (jnp.transpose(out, (0, 2, 1, 3)),
+            jnp.transpose(lse[..., 0], (0, 2, 1)))
+
+
+def flash_attention(q, k, v, *, scale: float, causal: bool = True,
+                    q_offset=0, block_q: int = 0, block_k: int = 0,
+                    interpret: bool = False) -> jnp.ndarray:
+    """Flash attention over BTNH-layout tensors.
+
+    q: (B, T, nh, hs); k, v: (B, S, nkv, hs) with nkv | nh. `q_offset`
+    must be a static 0 (prefill/training; the dispatcher routes
+    cached-decode offsets — including traced ones — to the naive path).
+    GQA kv heads are shared via the kernel's index maps; K/V are never
+    materialized per query head.
+    """
+    assert isinstance(q_offset, int) and q_offset == 0, (
+        "flash kernel requires a static q_offset == 0; cached-decode "
+        "offsets must use the naive path")
+    out, _ = flash_attention_lse(q, k, v, scale=scale, causal=causal,
+                                 block_q=block_q, block_k=block_k,
+                                 interpret=interpret)
+    return out

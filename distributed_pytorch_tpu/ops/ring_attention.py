@@ -102,6 +102,51 @@ def _init_carry(q, nh: int, Tq: int):
     return acc, m, l
 
 
+# --- flash-kernel hop path: per-hop (out, lse) pairs merged online --------
+#
+# The einsum hop (_chunk_update) materializes a (B, H, Tq, Tk) probability
+# slab per hop — O((T/sp)^2) transient HBM, recomputed in backward via
+# jax.checkpoint. When shapes allow, each hop instead runs the Pallas flash
+# kernel (ops/flash_attention.py) in causal mode for the diagonal chunk and
+# full mode for visible off-diagonal chunks: probabilities never leave
+# VMEM, and the kernel's custom vjp recomputes them blockwise in backward
+# (no jax.checkpoint wrapper needed). The cross-chunk merge is the standard
+# normalized-pair recurrence over (out, lse) — differentiable because the
+# kernel's lse output carries gradients (the dlse term folds into delta).
+
+def _flash_ring_ok(q, k, v) -> bool:
+    from distributed_pytorch_tpu.ops import attention_core as core
+    from distributed_pytorch_tpu.ops.flash_attention import (
+        flash_attention_usable)
+    return core._on_tpu() and flash_attention_usable(q, k, v)
+
+
+def _init_flash_carry(q, nh: int, Tq: int):
+    B, D = q.shape[0], q.shape[3]
+    out = jnp.zeros((B, Tq, nh, D), jnp.float32)
+    lse = jnp.full((B, Tq, nh), _NEG_INF, jnp.float32)
+    vma = tuple(jax.typeof(q).vma)
+    if vma:
+        out, lse = (jax.lax.pcast(t, vma, to="varying") for t in (out, lse))
+    return out, lse
+
+
+def _merge_flash(carry, out_c, lse_c):
+    out, lse = carry
+    new_lse = jnp.logaddexp(lse, lse_c)
+    w_old = jnp.exp(lse - new_lse)[..., None]
+    w_new = jnp.exp(lse_c - new_lse)[..., None]
+    return out * w_old + out_c.astype(jnp.float32) * w_new, new_lse
+
+
+def _flash_hop(carry, q, k, v, scale, causal_mode: bool):
+    from distributed_pytorch_tpu.ops.flash_attention import (
+        flash_attention_lse)
+    out_c, lse_c = flash_attention_lse(q, k, v, scale=scale,
+                                       causal=causal_mode)
+    return _merge_flash(carry, out_c, lse_c)
+
+
 def ring_attention_local(q, k, v, *, scale: float, axis_name: str = "seq",
                          sp: int, causal: bool = True) -> jnp.ndarray:
     """Ring attention body (call inside shard_map). q/k/v: local
@@ -110,12 +155,33 @@ def ring_attention_local(q, k, v, *, scale: float, axis_name: str = "seq",
     idx = jax.lax.axis_index(axis_name)
     B, Tloc, nh, D = q.shape
     qo = idx * Tloc
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
+
+    if causal and _flash_ring_ok(q, k, v):
+        # flash-kernel hops: O(Tloc) memory per hop, VMEM softmax. The
+        # diagonal is trace-time static: hop s=0 holds the device's OWN kv
+        # chunk (ko == qo uniformly), every later hop is either fully
+        # visible (ko < qo) or entirely future (skip) — so the causal
+        # kernel appears exactly once and hops 1..sp-1 carry a single cond
+        carry = _init_flash_carry(q, nh, Tloc)
+        carry = _flash_hop(carry, q, k, v, scale, True)   # s=0: diagonal
+        for s in range(1, sp):
+            k = jax.lax.ppermute(k, axis_name, perm)
+            v = jax.lax.ppermute(v, axis_name, perm)
+            ko = ((idx - s) % sp) * Tloc
+            carry = jax.lax.cond(
+                ko > qo,                     # entirely in the causal future
+                lambda c, *xs: c,
+                lambda c, q_, k_, v_: _flash_hop(c, q_, k_, v_, scale,
+                                                 False),
+                carry, q, k, v)
+        out, _ = carry
+        return out.astype(q.dtype)
 
     acc, m, l = _init_carry(q, nh, Tloc)
 
     step_fn = jax.checkpoint(functools.partial(_chunk_update, scale=scale,
                                                causal=causal))
-    perm = [(i, (i + 1) % sp) for i in range(sp)]
 
     carry = (acc, m, l)
     for s in range(sp):
@@ -170,9 +236,49 @@ def zigzag_ring_attention_local(q, k, v, *, scale: float,
     a_lo = idx * Ts                      # global offset of early stripe
     a_hi = (2 * sp - 1 - idx) * Ts       # global offset of late stripe
     q_lo, q_hi = q[:, :Ts], q[:, Ts:]
+    perm = [(i, (i + 1) % sp) for i in range(sp)]
 
-    step_fn = jax.checkpoint(functools.partial(_chunk_update, scale=scale,
-                                               causal=True))
+    use_flash = _flash_ring_ok(q_lo, k[:, :Ts], v[:, :Ts])
+
+    if use_flash:
+        # Stripe diagonals are trace-time static too: they occur ONLY at
+        # s=0 (a device's own stripes — pairs (lo,lo) and (hi,hi) causal,
+        # (hi,lo) fully visible since a_hi > b for every b < sp*Ts, and
+        # (lo,hi) always future); for s >= 1 the four pairs are each
+        # either fully visible or future — a single cond per pair.
+        def visible_update(carry, q_part, kv_k, kv_v, qo, ko):
+            return jax.lax.cond(
+                ko > qo,
+                lambda c: c,
+                lambda c: _flash_hop(c, q_part, kv_k, kv_v, scale, False),
+                carry)
+
+        c_lo = _init_flash_carry(q, nh, Ts)
+        c_hi = _init_flash_carry(q, nh, Ts)
+        k_lo, k_hi = k[:, :Ts], k[:, Ts:]
+        v_lo, v_hi = v[:, :Ts], v[:, Ts:]
+        c_lo = _flash_hop(c_lo, q_lo, k_lo, v_lo, scale, True)   # (lo,lo)
+        c_hi = _flash_hop(c_hi, q_hi, k_hi, v_hi, scale, True)   # (hi,hi)
+        c_hi = _flash_hop(c_hi, q_hi, k_lo, v_lo, scale, False)  # (hi,lo)
+        # (lo,hi) is always in the future — skipped statically
+        for s in range(1, sp):
+            k = jax.lax.ppermute(k, axis_name, perm)
+            v = jax.lax.ppermute(v, axis_name, perm)
+            j = (idx - s) % sp           # origin device of resident kv
+            b_lo = j * Ts
+            b_hi = (2 * sp - 1 - j) * Ts
+            k_lo, k_hi = k[:, :Ts], k[:, Ts:]
+            v_lo, v_hi = v[:, :Ts], v[:, Ts:]
+            # statically decidable pairs: (hi, lo') is ALWAYS visible
+            # (a_hi >= sp*Ts > any early stripe) and (lo, hi') is always
+            # future; the two remaining pairs need a runtime cond
+            c_lo = visible_update(c_lo, q_lo, k_lo, v_lo, a_lo, b_lo)
+            c_hi = _flash_hop(c_hi, q_hi, k_lo, v_lo, scale, False)
+            c_hi = visible_update(c_hi, q_hi, k_hi, v_hi, a_hi, b_hi)
+        return jnp.concatenate([c_lo[0], c_hi[0]], axis=1).astype(q.dtype)
+
+    step_fn = jax.checkpoint(functools.partial(_chunk_update,
+                                               scale=scale, causal=True))
 
     def masked_update(carry, q_part, kv_k, kv_v, qo, ko):
         return jax.lax.cond(
@@ -181,7 +287,6 @@ def zigzag_ring_attention_local(q, k, v, *, scale: float,
             lambda c: c,
             carry)
 
-    perm = [(i, (i + 1) % sp) for i in range(sp)]
     c_lo, c_hi = _init_carry(q, nh, Ts), _init_carry(q, nh, Ts)
     for s in range(sp):
         j = (idx - s) % sp               # origin device of resident kv

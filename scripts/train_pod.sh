@@ -44,7 +44,7 @@ N_EMBD=768
 VOCAB_SIZE=50304
 BLOCK_SIZE=1024
 POS_EMB="rope"
-UP_DIM=3072
+UP_DIM=2048                    # swiglu 2/3 scaling: a true ~124M (config.flagship_gpt124m)
 NON_LINEARITY="swiglu"
 ATTN="mha"
 N_HEAD=12

@@ -85,7 +85,9 @@ def test_bf16_forward_close():
 def test_usable_gate():
     q, k, v = rand_qkv(jax.random.PRNGKey(0), 1, 128, 128, 2, 2, 64)
     assert flash_attention_usable(q, k, v, causal=True)
-    assert not flash_attention_usable(q, k, v, causal=False)
+    # round 4: the kernel grew a full-attention mode (ring off-diagonal
+    # chunks), so non-causal shapes are usable too
+    assert flash_attention_usable(q, k, v, causal=False)
     # decode-step shape: single query row -> naive path
     assert not flash_attention_usable(q[:, :1], k, v, causal=True)
     # fp16 not supported on TPU path
@@ -134,3 +136,62 @@ def test_model_trains_with_pallas_interpret(monkeypatch):
     for a, b in zip(flat_p, flat_x):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4,
                                    atol=5e-4)
+
+
+def _naive_out_lse(q, k, v, scale, causal):
+    nh, nkv = q.shape[2], k.shape[2]
+    if nkv != nh:
+        k = jnp.repeat(k, nh // nkv, axis=2)
+        v = jnp.repeat(v, nh // nkv, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        T, S = q.shape[1], k.shape[1]
+        mask = jnp.arange(T)[:, None] >= jnp.arange(S)[None, :]
+        s = jnp.where(mask[None, None], s, -1e30)
+    lse = jax.nn.logsumexp(s, axis=-1)                    # (B,H,T)
+    p = jnp.exp(s - lse[..., None])
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out, jnp.transpose(lse, (0, 2, 1))             # BTNH, (B,T,H)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_lse_matches_naive(causal):
+    """(out, lse) parity for both masking modes — lse is the ring merge's
+    contract (ops/ring_attention.py)."""
+    from distributed_pytorch_tpu.ops.flash_attention import flash_attention_lse
+    q, k, v = rand_qkv(jax.random.PRNGKey(3), 2, 64, 64, 4, 2, 16)
+    scale = 0.25
+    ref_o, ref_l = _naive_out_lse(q, k, v, scale, causal)
+    out, lse = flash_attention_lse(q, k, v, scale=scale, causal=causal,
+                                   interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_o),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(ref_l),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_lse_gradients_including_dlse(causal):
+    """A loss that touches BOTH outputs: the custom vjp must fold d/dlse
+    into the delta term correctly (ds = p*(dp - delta + dlse))."""
+    from distributed_pytorch_tpu.ops.flash_attention import flash_attention_lse
+    q, k, v = rand_qkv(jax.random.PRNGKey(4), 1, 32, 32, 2, 2, 16)
+    scale = 0.25
+    w = jax.random.normal(jax.random.PRNGKey(5), q.shape)
+    u = jax.random.normal(jax.random.PRNGKey(6), (1, 32, 2))
+
+    def loss_flash(q, k, v):
+        o, l = flash_attention_lse(q, k, v, scale=scale, causal=causal,
+                                   interpret=True)
+        return jnp.sum(o * w) + jnp.sum(l * u)
+
+    def loss_naive(q, k, v):
+        o, l = _naive_out_lse(q, k, v, scale, causal)
+        return jnp.sum(o * w) + jnp.sum(l * u)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gn = jax.grad(loss_naive, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gn, "q k v".split()):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
+                                   atol=2e-4, err_msg=f"d{name}")

@@ -163,13 +163,16 @@ def _worker_main(platform: str, only_recipe: str | None = None) -> None:
 
 
 def _spawn_worker(platform: str, timeout_s: int,
-                  only_recipe: str | None = None) -> dict | None:
+                  only_recipe: str | None = None,
+                  extra_env: dict | None = None) -> dict | None:
     """Run the worker subprocess; return its parsed JSON line or None."""
     try:
         cmd = [sys.executable, __file__, "--worker", platform]
         if only_recipe:
             cmd.append(only_recipe)
-        r = subprocess.run(cmd, capture_output=True, timeout=timeout_s)
+        env = dict(os.environ, **extra_env) if extra_env else None
+        r = subprocess.run(cmd, capture_output=True, timeout=timeout_s,
+                           env=env)
         sys.stderr.write(r.stderr.decode()[-4000:])
         if r.returncode == 0 and r.stdout:
             for line in reversed(r.stdout.decode().strip().splitlines()):
@@ -195,7 +198,28 @@ def main() -> None:
 
     out = None
     if tpu_available():
-        out = _spawn_worker("tpu", timeout_s=1800)
+        if not (os.environ.get("BENCH_BATCH")
+                or os.environ.get("BENCH_REMAT")):
+            # No explicit config: measure the ambitious default (bigger
+            # per-chip batch amortizes per-step overhead; attention-only
+            # remat keeps it inside HBM) AND the conservative known-good
+            # one, report the better — a 2-point mini-sweep inside the
+            # bench budget (each leg ~2 min; compiles hit /tmp/jax_ccache
+            # on reruns). A failing ambitious leg just loses its entry.
+            candidates = []
+            for name, env in (("batch32_remat_attn",
+                               {"BENCH_BATCH": "32", "BENCH_REMAT": "1"}),
+                              ("batch16", None)):
+                r = _spawn_worker("tpu", timeout_s=1500, extra_env=env)
+                if r:
+                    r["config"] = name
+                    candidates.append(r)
+            if candidates:
+                out = max(candidates, key=lambda r: r.get("value", 0))
+                out["configs_tried"] = {
+                    c["config"]: c["value"] for c in candidates}
+        if out is None:
+            out = _spawn_worker("tpu", timeout_s=1800)
         if out and out.get("n_chips", 1) > 1:
             # second worker for the DDP leg of the FSDP-vs-DDP comparison
             # (fresh process -> uncontaminated peak-HBM stats)

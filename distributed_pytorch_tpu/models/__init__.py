@@ -5,3 +5,7 @@ more times inside its kaggle scripts; here it exists exactly once)."""
 from distributed_pytorch_tpu.models.gpt import LLM, Block, init_cache  # noqa: F401
 from distributed_pytorch_tpu.models.attention import GQA, NaiveMLA, FullMLA, Attention  # noqa: F401
 from distributed_pytorch_tpu.models.mlp import MLP, MoE  # noqa: F401
+from distributed_pytorch_tpu.models.pipeline import (  # noqa: F401
+    stack_block_params,
+    unstack_block_params,
+)

@@ -8,3 +8,15 @@ from distributed_pytorch_tpu.ops.rope import (  # noqa: F401
     apply_rotary_emb,
 )
 from distributed_pytorch_tpu.ops.attention_core import sdpa  # noqa: F401
+from distributed_pytorch_tpu.ops.losses import (  # noqa: F401
+    fused_cross_entropy,
+    unchunked_cross_entropy,
+)
+# NB: the `flash_attention` FUNCTION is deliberately not re-exported here —
+# binding it on the package would shadow the `ops.flash_attention`
+# submodule attribute (import it from the submodule directly).
+from distributed_pytorch_tpu.ops.flash_attention import (  # noqa: F401
+    flash_attention_lse,
+    flash_attention_usable,
+)
+from distributed_pytorch_tpu.ops.ring_attention import sp_sdpa  # noqa: F401

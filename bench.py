@@ -209,6 +209,9 @@ def main() -> None:
             candidates = []
             for name, env in (("batch32_remat_attn",
                                {"BENCH_BATCH": "32", "BENCH_REMAT": "1"}),
+                              ("batch32_remat_pallas",
+                               {"BENCH_BATCH": "32", "BENCH_REMAT": "1",
+                                "BENCH_ATTN": "pallas"}),
                               ("batch16", None)):
                 r = _spawn_worker("tpu", timeout_s=1500, extra_env=env)
                 if r:

@@ -127,11 +127,13 @@ def main(argv=None) -> None:
     from distributed_pytorch_tpu.parallel import context
     with (context.use_mesh(mesh) if mesh is not None
           else contextlib.nullcontext()):
-        for i in range(args.num_samples):
-            out = gen(variables, prompt, jax.random.fold_in(rng, i))
-            toks = jax.device_get(out)[0].tolist()
-            print("-" * 40)
-            print(enc.decode(toks) if enc is not None else toks)
+        # all samples decode as ONE batched call (one compile, one scan);
+        # jax.random.categorical draws independent noise per batch row
+        prompts = jnp.tile(prompt, (args.num_samples, 1))
+        out = jax.device_get(gen(variables, prompts, rng))
+    for toks in out.tolist():
+        print("-" * 40)
+        print(enc.decode(toks) if enc is not None else toks)
 
 
 if __name__ == "__main__":

@@ -205,6 +205,8 @@ class TrainConfig:
     warmup_steps: int = 100
     weight_decay: float = 0.1
     grad_clip: float = 1.0
+    optimizer: str = "adamw"         # adamw | lion | adafactor
+                                     # (reference: AdamW only, model.py:619)
     save_model: bool = False
     save_stats: bool = True          # persist run stats as <ckpt>/stats.json
                                      # (reference `<name>_stats.pt`,
@@ -247,6 +249,8 @@ class TrainConfig:
             f"unknown attn_impl {self.attn_impl!r}"
         assert self.platform in ("auto", "tpu", "cpu"), \
             f"unknown platform {self.platform!r}"
+        assert self.optimizer in ("adamw", "lion", "adafactor"), \
+            f"unknown optimizer {self.optimizer!r}"
 
 
 # ---------------------------------------------------------------------------

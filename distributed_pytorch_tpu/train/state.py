@@ -64,15 +64,39 @@ def _decay_mask(params: Any) -> Any:
 
 
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
-    return optax.chain(
-        optax.clip_by_global_norm(cfg.grad_clip),
-        optax.adamw(
-            learning_rate=lr_schedule(cfg),
+    """Optimizer chain: global-norm clip + the configured update rule.
+
+    'adamw' mirrors the reference's configure_optimizers (fused AdamW,
+    model.py:619-637). 'lion' and 'adafactor' exceed the reference:
+    Lion halves optimizer HBM (one moment instead of two; typical LR ~3-10x
+    smaller than AdamW's), Adafactor's factored second moment drops it to
+    O(rows+cols) — both compose with the ZeRO recipes, whose opt-state
+    sharding is shape-matched per leaf (parallel/sharding.py
+    shard_like_params), not optimizer-specific."""
+    sched = lr_schedule(cfg)
+    if cfg.optimizer == "lion":
+        tx = optax.lion(learning_rate=sched, b1=0.9, b2=0.99,
+                        weight_decay=cfg.weight_decay, mask=_decay_mask)
+    elif cfg.optimizer == "adafactor":
+        # optax's weight_decay_rate is a RAW per-step multiplier, not
+        # LR-coupled like AdamW's decoupled decay (0.1/step would shrink
+        # weights 10% every step and diverge). Match AdamW's effective
+        # magnitude at peak LR: decay/step = weight_decay * learning_rate
+        # (constant — adafactor's knob can't follow the schedule; the
+        # divergence from AdamW semantics is this comment's contract).
+        wd = (cfg.weight_decay * cfg.learning_rate
+              if cfg.weight_decay else None)
+        tx = optax.adafactor(learning_rate=sched,
+                             weight_decay_rate=wd,
+                             weight_decay_mask=_decay_mask)
+    else:
+        tx = optax.adamw(
+            learning_rate=sched,
             b1=0.9, b2=0.999, eps=1e-8,      # torch AdamW defaults
             weight_decay=cfg.weight_decay,
             mask=_decay_mask,
-        ),
-    )
+        )
+    return optax.chain(optax.clip_by_global_norm(cfg.grad_clip), tx)
 
 
 def build_model(model_cfg: LLMConfig, train_cfg: TrainConfig) -> LLM:

@@ -37,14 +37,21 @@ The public entry points keep the interface the dispatcher
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# At 128x128 the grid is B*H*(T/128)^2 ~= 12k steps/layer of ~2 MFLOP each
+# and per-grid-step overhead dominates (v5e micro-bench, PERF.md round 4:
+# 128x128 lost to 256x512 by ~50ms/call even with host-upload noise washing
+# out kernel differences). 256x512 is the provisional winner; env knobs let
+# scripts/mfu_sweep.py A/B block sizes in the real train step without an
+# API change.
+DEFAULT_BLOCK_Q = int(os.environ.get("FLASH_BLOCK_Q", "256"))
+DEFAULT_BLOCK_K = int(os.environ.get("FLASH_BLOCK_K", "512"))
 
 _NEG_INF = -1e30  # large-negative instead of -inf: keeps masked rows NaN-free
 

@@ -75,6 +75,14 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     shard_like_params), not optimizer-specific."""
     sched = lr_schedule(cfg)
     if cfg.optimizer == "lion":
+        if cfg.learning_rate > 2e-4:
+            import warnings
+            warnings.warn(
+                f"optimizer=lion with learning_rate={cfg.learning_rate:g}: "
+                "Lion's sign-based update typically needs a ~3-10x smaller "
+                "LR than AdamW (an AdamW-tuned 6e-4-class value usually "
+                "diverges). Set --learning_rate explicitly for lion.",
+                RuntimeWarning, stacklevel=2)
         tx = optax.lion(learning_rate=sched, b1=0.9, b2=0.99,
                         weight_decay=cfg.weight_decay, mask=_decay_mask)
     elif cfg.optimizer == "adafactor":

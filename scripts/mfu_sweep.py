@@ -44,13 +44,15 @@ def time_variant(batch: int, attn_impl: str, act_recomp: bool,
         x = jax.random.randint(rng, (1, batch, 1024), 0, 50304, jnp.int32)
         y = jax.random.randint(rng, (1, batch, 1024), 0, 50304, jnp.int32)
         state, m = step(state, x, y)       # compile + warmup
-        jax.block_until_ready(m)
-        # async dispatch, one sync at the end — the trainer's sync
-        # discipline (train/loop.py): host round-trips overlap compute
+        jax.device_get(m)
+        # Sync via device_get of the step metrics, exactly like the trainer's
+        # log-boundary sync (train/loop.py). Through the axon tunnel,
+        # block_until_ready is NOT a reliable fence — a dispatch-only loop
+        # timed ~2.5 ms/step (2600% "MFU"); fetching the metric values is.
         t0 = time.perf_counter()
         for _ in range(iters):
             state, m = step(state, x, y)
-        jax.block_until_ready(m)
+        jax.device_get(m)
         times = [(time.perf_counter() - t0) / iters]
     except Exception as e:  # OOM etc.
         print(f"batch={batch:3d} attn={attn_impl:6s} remat={act_recomp!s:5s} "
@@ -83,8 +85,8 @@ def main():
 
     if args.one:
         b, a, r, l = args.one.split(",")
-        time_variant(int(b), a, r == "True", l, args.iters)
-        return
+        ok = time_variant(int(b), a, r == "True", l, args.iters)
+        sys.exit(0 if ok else 1)
 
     print(f"device: {jax.devices()[0].device_kind}, "
           f"backend: {jax.default_backend()}", flush=True)
@@ -100,6 +102,29 @@ def main():
             (64, "pallas", True, "fused"),
             (64, "xla", True, "fused"),
         ]
+    elif args.variants == "blocks":
+        # flash-kernel block-size ablation inside the REAL train step (the
+        # profile shows XLA attention burns ~150ms/step materializing f32
+        # scores; this decides whether the in-house kernel replaces it and
+        # at which tile size). FLASH_BLOCK_* is read by ops/flash_attention
+        # at import, so each subprocess gets its own value.
+        grid = [
+            (16, "xla", False, "fused"),
+            (16, "pallas", False, "fused", {"FLASH_BLOCK_Q": "128",
+                                            "FLASH_BLOCK_K": "128"}),
+            (16, "pallas", False, "fused", {"FLASH_BLOCK_Q": "256",
+                                            "FLASH_BLOCK_K": "256"}),
+            (16, "pallas", False, "fused", {"FLASH_BLOCK_Q": "256",
+                                            "FLASH_BLOCK_K": "512"}),
+            (16, "pallas", False, "fused", {"FLASH_BLOCK_Q": "512",
+                                            "FLASH_BLOCK_K": "512"}),
+            (16, "pallas", False, "fused", {"FLASH_BLOCK_Q": "512",
+                                            "FLASH_BLOCK_K": "1024"}),
+            (32, "pallas", False, "fused", {"FLASH_BLOCK_Q": "256",
+                                            "FLASH_BLOCK_K": "512"}),
+            (32, "pallas", False, "fused", {"FLASH_BLOCK_Q": "512",
+                                            "FLASH_BLOCK_K": "512"}),
+        ]
     else:
         grid = list(itertools.product((16, 32, 64), ("xla", "pallas"),
                                       (False, True), ("fused",)))
@@ -108,18 +133,32 @@ def main():
     # an in-process loop would report every variant's 'peak HBM' as the max
     # over all PRIOR variants (hiding exactly the remat/batch savings this
     # sweep measures); a variant that OOMs also can't take down the rest
+    import os
     import subprocess
-    for batch, attn, remat, loss in grid:
+    for variant in grid:
+        batch, attn, remat, loss = variant[:4]
+        extra_env = variant[4] if len(variant) > 4 else {}
         cmd = [sys.executable, __file__, "--iters", str(args.iters),
                "--one", f"{batch},{attn},{remat},{loss}"]
-        try:
-            r = subprocess.run(cmd, timeout=1200)
-            if r.returncode != 0:
+        env = dict(os.environ, **extra_env)
+        tag = ",".join(f"{k}={v}" for k, v in extra_env.items())
+        if tag:
+            print(f"[{tag}]", flush=True)
+        # retry once on rc!=0: the tunnel's remote-compile service throws
+        # transient HTTP 500s (observed on 4/8 variants in one pass). A
+        # TIMEOUT is never retried — a wedged tunnel hangs identically on
+        # attempt 2 and would double a dead sweep's wall-clock.
+        for attempt in (1, 2):
+            try:
+                r = subprocess.run(cmd, timeout=1200, env=env)
+                if r.returncode == 0:
+                    break
                 print(f"variant {batch},{attn},{remat},{loss}: "
-                      f"rc={r.returncode}", flush=True)
-        except subprocess.TimeoutExpired:
-            print(f"variant {batch},{attn},{remat},{loss}: TIMEOUT",
-                  flush=True)
+                      f"rc={r.returncode} (attempt {attempt})", flush=True)
+            except subprocess.TimeoutExpired:
+                print(f"variant {batch},{attn},{remat},{loss}: TIMEOUT "
+                      f"(no retry)", flush=True)
+                break
 
 
 if __name__ == "__main__":

@@ -95,8 +95,11 @@ class LLMConfig:
     act_recomp_policy: str = "block"  # 'block' | 'attn'
 
     # loss path: 'fused' computes CE blockwise over T without materializing
-    # the (B, T, V) logits (ops/losses.py — the round-3 MFU fix); 'unchunked'
-    # is the full-logits semantics oracle. loss_chunk: T-chunk size, 0 = auto.
+    # the (B, T, V) logits (ops/losses.py — the round-3 MFU fix); 'pallas'
+    # streams (token, vocab) tiles through VMEM so logits never touch HBM
+    # at all (ops/fused_ce.py; falls back to 'fused' when unusable —
+    # tp/sp live, odd shapes, non-TPU); 'unchunked' is the full-logits
+    # semantics oracle. loss_chunk: T-chunk size for 'fused', 0 = auto.
     loss_impl: str = "fused"
     loss_chunk: int = 0
 
@@ -141,7 +144,7 @@ class LLMConfig:
         assert self.capacity_factor > 0
         assert self.act_recomp_policy in ("block", "attn"), \
             f"unknown act_recomp_policy {self.act_recomp_policy!r}"
-        assert self.loss_impl in ("fused", "unchunked"), \
+        assert self.loss_impl in ("fused", "unchunked", "pallas"), \
             f"unknown loss_impl {self.loss_impl!r}"
         if self.loss_chunk > 0:
             # a non-dividing chunk would silently fall back to the

@@ -181,10 +181,29 @@ class LLM(nn.Module):
             # T-chunking would idle devices, so sp uses the unchunked path.
             from distributed_pytorch_tpu.parallel import context
             emb_mat = tkn_emb.embedding.astype(dt)  # (V, C)
-            if cfg.loss_impl == "fused" and context.seq_axis_size() <= 1:
+            loss_impl = cfg.loss_impl
+            if loss_impl == "pallas":
+                # Streaming-kernel gates: no vocab-parallel embedding (tp
+                # shards V and the kernel's logsumexp is per-shard-local),
+                # no live 'seq' axis (T is sequence-sharded), shapes the
+                # kernel tiles, and a TPU backend (interpret on CPU is
+                # test-only slow). Otherwise degrade to the chunked path.
+                from distributed_pytorch_tpu.ops.fused_ce import (
+                    pallas_ce_usable, pallas_cross_entropy)
+                mesh = context.get_mesh()
+                tp = mesh.shape.get("model", 1) if mesh is not None else 1
+                dp = mesh.shape.get("data", 1) if mesh is not None else 1
+                n_local = (x.shape[0] // dp) * x.shape[1]
+                if (context.seq_axis_size() <= 1 and tp == 1
+                        and jax.default_backend() == "tpu"
+                        and pallas_ce_usable(n_local, x.shape[-1], x.dtype)):
+                    main_loss = pallas_cross_entropy(x, emb_mat, targets)
+                else:
+                    loss_impl = "fused"
+            if loss_impl == "fused" and context.seq_axis_size() <= 1:
                 main_loss = fused_cross_entropy(
                     x, emb_mat, targets, chunk=cfg.loss_chunk)
-            else:
+            elif loss_impl != "pallas":
                 main_loss = unchunked_cross_entropy(x, emb_mat, targets)
             loss = main_loss + total_aux / cfg.n_layer
             # full logits stay available to callers (tests, analysis); when

@@ -124,6 +124,10 @@ def main():
                                             "FLASH_BLOCK_K": "512"}),
             (32, "pallas", False, "fused", {"FLASH_BLOCK_Q": "512",
                                             "FLASH_BLOCK_K": "512"}),
+            # streaming pallas CE (ops/fused_ce.py) vs the chunked scan
+            (16, "xla", False, "pallas"),
+            (16, "pallas", False, "pallas", {"FLASH_BLOCK_Q": "256",
+                                             "FLASH_BLOCK_K": "512"}),
         ]
     else:
         grid = list(itertools.product((16, 32, 64), ("xla", "pallas"),

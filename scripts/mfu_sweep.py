@@ -126,6 +126,9 @@ def main():
                                             "FLASH_BLOCK_K": "512"}),
             # streaming pallas CE (ops/fused_ce.py) vs the chunked scan
             (16, "xla", False, "pallas"),
+            (16, "xla", False, "pallas", {"CE_BLOCK_N": "1024"}),
+            (16, "xla", False, "pallas", {"CE_BLOCK_N": "256",
+                                          "CE_BLOCK_V": "4096"}),
             (16, "pallas", False, "pallas", {"FLASH_BLOCK_Q": "256",
                                              "FLASH_BLOCK_K": "512"}),
         ]

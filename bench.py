@@ -98,7 +98,8 @@ def run_bench(platform: str, only_recipe: str | None = None) -> dict:
         from distributed_pytorch_tpu.config import flagship_gpt124m
         model_cfg = flagship_gpt124m(
             act_recomp=os.environ.get("BENCH_REMAT", "0") == "1",
-            act_recomp_policy="attn")
+            act_recomp_policy="attn",
+            loss_impl=os.environ.get("BENCH_LOSS", "fused"))
         per_chip = int(os.environ.get("BENCH_BATCH", "16"))
         iters = int(os.environ.get("BENCH_ITERS", "12"))
         attn_impl = os.environ.get("BENCH_ATTN", "auto")
@@ -199,7 +200,9 @@ def main() -> None:
     out = None
     if tpu_available():
         if not (os.environ.get("BENCH_BATCH")
-                or os.environ.get("BENCH_REMAT")):
+                or os.environ.get("BENCH_REMAT")
+                or os.environ.get("BENCH_LOSS")
+                or os.environ.get("BENCH_ATTN")):
             # No explicit config: measure the ambitious default (bigger
             # per-chip batch amortizes per-step overhead; attention-only
             # remat keeps it inside HBM) AND the conservative known-good
@@ -207,8 +210,9 @@ def main() -> None:
             # bench budget (each leg ~2 min; compiles hit /tmp/jax_ccache
             # on reruns). A failing ambitious leg just loses its entry.
             candidates = []
-            for name, env in (("batch32_remat_attn",
-                               {"BENCH_BATCH": "32", "BENCH_REMAT": "1"}),
+            for name, env in (("batch16_flash_streamce",
+                               {"BENCH_BATCH": "16", "BENCH_ATTN": "pallas",
+                                "BENCH_LOSS": "pallas"}),
                               ("batch32_remat_pallas",
                                {"BENCH_BATCH": "32", "BENCH_REMAT": "1",
                                 "BENCH_ATTN": "pallas"}),

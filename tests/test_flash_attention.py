@@ -195,3 +195,30 @@ def test_flash_lse_gradients_including_dlse(causal):
     for a, b, name in zip(gf, gn, "q k v".split()):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4,
                                    atol=2e-4, err_msg=f"d{name}")
+
+
+@pytest.mark.parametrize("bq,bk", [(32, 64), (64, 32), (128, 64)])
+def test_rectangular_blocks_fwd_bwd(bq, bk):
+    """block_q != block_k exercises the causal-frontier math on
+    rectangular tiles (_last_visible_kv/_first_visible_q and the
+    DMA-clamp index maps) — the production default is 256x512."""
+    T, nh, nkv, hs = 128, 4, 2, 32
+    q, k, v = rand_qkv(jax.random.PRNGKey(5), 2, T, T, nh, nkv, hs)
+    scale = 1.0 / hs ** 0.5
+    w = jax.random.normal(jax.random.PRNGKey(6), q.shape)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) * w)
+
+    flash = loss(lambda q, k, v: flash_attention(
+        q, k, v, scale=scale, block_q=bq, block_k=bk, interpret=True))
+    naive = loss(lambda q, k, v: _naive_sdpa(
+        q, k, v, scale=scale, q_offset=0, causal=True))
+    np.testing.assert_allclose(np.asarray(flash(q, k, v)),
+                               np.asarray(naive(q, k, v)),
+                               rtol=2e-4, atol=2e-4)
+    g_f = jax.grad(flash, argnums=(0, 1, 2))(q, k, v)
+    g_n = jax.grad(naive, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_f, g_n):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)

@@ -181,3 +181,18 @@ def test_pallas_ce_dp_shard_map_parity():
     for r, g in zip(g_ref, g_got):
         np.testing.assert_allclose(np.asarray(g), np.asarray(r),
                                    rtol=2e-5, atol=2e-6)
+
+
+def test_pallas_ce_real_vocab_padding():
+    """GPT-2 vocab 50304 pads to 51200 (25 x 2048 tiles): the production
+    padding path with the last tile 1152-valid, tiny N/C to keep
+    interpret mode fast."""
+    x, emb, tgt = _pdata(B=2, T=32, C=128, V=50304)
+    ref = unchunked_cross_entropy(x, emb, tgt)
+    got = pallas_cross_entropy(x, emb, tgt, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-6)
+    g_ref = jax.grad(lambda e: unchunked_cross_entropy(x, e, tgt))(emb)
+    g_got = jax.grad(
+        lambda e: pallas_cross_entropy(x, e, tgt, interpret=True))(emb)
+    np.testing.assert_allclose(np.asarray(g_got), np.asarray(g_ref),
+                               rtol=2e-5, atol=2e-6)

@@ -5,13 +5,21 @@ This is the framework's native-kernel replacement for the fused attention
 the reference delegates to `F.scaled_dot_product_attention` (reference
 single-gpu/model.py:149). Design (per the Pallas TPU playbook):
 
-* Grid (B, H, q_blocks, kv_blocks), `dimension_semantics=('parallel',
-  'parallel', 'parallel', 'arbitrary')`. Each grid step streams ONE
-  (block_k, D) K/V tile through the MXU; the online-softmax state (running
-  max m, normalizer l, f32 accumulator) lives in VMEM scratch that persists
+* The (batch, head) pair is flattened into one ROW axis and the grid is
+  (rows/block_h, q_blocks, kv_blocks), `dimension_semantics=('parallel',
+  'parallel', 'arbitrary')`. Each grid step processes `block_h` rows'
+  (block_q x block_k) score tiles batched through the MXU, streaming ONE
+  (block_k, D) K/V tile per row; the online-softmax state (running max m,
+  normalizer l, f32 accumulator) lives in VMEM scratch that persists
   across the innermost kv dimension. VMEM use is constant in sequence
   length — attention probabilities never exist in HBM, so memory is O(T)
   instead of O(T^2) and sequences of 32k+ compile.
+* Why a row-group block: at the flagship shape (B16 H12 T1024 D64) with
+  128x128 tiles the grid is ~12k steps/layer of ~2 MFLOP each and
+  per-grid-step overhead dominates the kernel (v5e micro-bench, PERF.md
+  round 4 — 128x128 lost ~50 ms/call to 256x512 from grid-step count
+  alone). Grouping `block_h` rows per step divides the step count again
+  without changing total VPU/MXU work.
 * Causal masking is positional (qpos >= kpos), so the KV length S may
   exceed the query length T (prefill into a longer zero-filled cache
   buffer): the zero tail is always masked. Blocks strictly above the
@@ -21,13 +29,14 @@ single-gpu/model.py:149). Design (per the Pallas TPU playbook):
 * Backward = two kernels (FlashAttention-2): dq accumulates over kv tiles;
   dk/dv accumulate over q tiles; both recompute p from the saved
   logsumexp instead of storing probabilities.
-* GQA never materializes repeated K/V: the kv BlockSpec index maps send
-  query head h to kv head h // group, so the same kv tile serves the whole
-  group straight from HBM (a materialized repeat would multiply KV bytes by
-  the group size at exactly the long-S scales this kernel targets). The
-  backward emits per-query-head dk/dv and group-sums them host-side.
-  Head dims must be sublane multiples (hs % 8); there is no padding path —
-  odd head dims fall back to the XLA impl via `flash_attention_usable`.
+* GQA never materializes repeated K/V: with `rep = nh // nkv > 1` the
+  row group is 1 and the kv BlockSpec index maps send query row r to kv
+  row r // rep, so the same kv tile serves the whole group straight from
+  HBM (a materialized repeat would multiply KV bytes by the group size at
+  exactly the long-S scales this kernel targets). The backward emits
+  per-query-row dk/dv and group-sums them host-side. Head dims must be
+  sublane multiples (hs % 8); there is no padding path — odd head dims
+  fall back to the XLA impl via `flash_attention_usable`.
 
 The public entry points keep the interface the dispatcher
 (ops/attention_core.py) fixed while this was a stub: `flash_attention` and
@@ -44,19 +53,18 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# At 128x128 the grid is B*H*(T/128)^2 ~= 12k steps/layer of ~2 MFLOP each
-# and per-grid-step overhead dominates (v5e micro-bench, PERF.md round 4:
-# 128x128 lost to 256x512 by ~50ms/call even with host-upload noise washing
-# out kernel differences). 256x512 is the provisional winner; env knobs let
-# scripts/mfu_sweep.py A/B block sizes in the real train step without an
-# API change.
+# Tile-size knobs (read at import so scripts/mfu_sweep.py --variants blocks
+# can A/B them per subprocess without an API change). 256x512 q/kv tiles and
+# an 8-row group are the provisional v5e winners pending the on-hardware
+# block sweep (PERF.md round 4).
 DEFAULT_BLOCK_Q = int(os.environ.get("FLASH_BLOCK_Q", "256"))
 DEFAULT_BLOCK_K = int(os.environ.get("FLASH_BLOCK_K", "512"))
+DEFAULT_BLOCK_H = int(os.environ.get("FLASH_BLOCK_H", "8"))
 
 _NEG_INF = -1e30  # large-negative instead of -inf: keeps masked rows NaN-free
 
 _SEMANTICS = pltpu.CompilerParams(
-    dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"))
+    dimension_semantics=("parallel", "parallel", "arbitrary"))
 
 
 def _last_visible_kv(i, block_q: int, block_k: int):
@@ -70,26 +78,26 @@ def _first_visible_q(j, block_q: int, block_k: int):
 
 
 def _mask_scores(s, i, j, block_q, block_k):
-    """Causal mask for one (block_q, block_k) score tile. Positions are
+    """Causal mask for one (g, block_q, block_k) score tile. Positions are
     absolute: qpos = i*block_q + row, kpos = j*block_k + col; a query
     attends keys with kpos <= qpos (reference model.py:225-226 triu
     semantics with offset 0)."""
-    qpos = i * block_q + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 0)
-    kpos = j * block_k + jax.lax.broadcasted_iota(
-        jnp.int32, (block_q, block_k), 1)
+    qpos = i * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    kpos = j * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
     return jnp.where(qpos >= kpos, s, _NEG_INF)
 
 
-def _dot(a, b, trans_b=False):
-    dims = (((1,), (1 if trans_b else 0,)), ((), ()))
+def _bdot(a, b, trans_b=False):
+    """Row-batched matmul with f32 accumulation: a (g, m, k) @ b (g, k, n)
+    — or b (g, n, k) when trans_b — over the shared leading group dim."""
+    dims = (((2,), (2 if trans_b else 1,)), ((0,), (0,)))
     return jax.lax.dot_general(a, b, dims,
                                preferred_element_type=jnp.float32)
 
 
-def _dot_t(a, b):
-    """a^T @ b with f32 accumulation."""
-    return jax.lax.dot_general(a, b, (((0,), (0,)), ((), ())),
+def _bdot_t(a, b):
+    """Row-batched a^T @ b: a (g, m, n), b (g, m, k) -> (g, n, k)."""
+    return jax.lax.dot_general(a, b, (((1,), (1,)), ((0,), (0,))),
                                preferred_element_type=jnp.float32)
 
 
@@ -103,15 +111,43 @@ def _sds(shape, dtype, like):
     return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
 
 
+def _kv_spec(rep: int, g: int, block_q: int, block_k: int, D: int,
+             causal: bool):
+    """Shared K/V BlockSpec for the forward and dq grids (both iterate
+    (row-group r, q-tile i, kv-tile j)): GQA (g == 1) maps query row r to
+    kv row r // rep — no materialized repeat — and skipped upper-triangle
+    tiles clamp to the causal frontier so the revolving-buffer DMA sees an
+    unchanged index (no fetch). One definition keeps forward and backward
+    kv fetches in lockstep."""
+    def kv_idx(r, i, j):
+        jc = j if not causal \
+            else jnp.minimum(j, _last_visible_kv(i, block_q, block_k))
+        return (r if rep == 1 else r // rep, jc, 0)
+
+    return pl.BlockSpec((g if rep == 1 else 1, block_k, D), kv_idx)
+
+
+def _pick_group(n_rows: int, rep: int, preferred: int) -> int:
+    """Row-group size: a divisor of n_rows, 1 unless kv rows map 1:1
+    (rep == 1 — with grouped rows a GQA group would need strided kv
+    tiles)."""
+    if rep != 1:
+        return 1
+    g = min(preferred, n_rows)
+    while g > 1 and n_rows % g != 0:
+        g -= 1
+    return max(g, 1)
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
                 *, scale, block_q, block_k, causal):
-    i, j = pl.program_id(2), pl.program_id(3)
+    i, j = pl.program_id(1), pl.program_id(2)
     last_j = _last_visible_kv(i, block_q, block_k) if causal \
-        else pl.num_programs(3) - 1
+        else pl.num_programs(2) - 1
 
     @pl.when(j == 0)
     def _():
@@ -124,8 +160,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         # operands stay in input dtype (bf16 on TPU): the MXU accumulates in
         # f32 via preferred_element_type — casting inputs up would force
         # slow fp32 MXU passes
-        q, k, v = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0]
-        s = _dot(q, k, trans_b=True) * scale             # (bq, bk) f32
+        q, k, v = q_ref[:], k_ref[:], v_ref[:]
+        s = _bdot(q, k, trans_b=True) * scale           # (g, bq, bk) f32
         if causal:
             s = _mask_scores(s, i, j, block_q, block_k)
         m_prev, l_prev = m_ref[:], l_ref[:]
@@ -134,55 +170,48 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         p = jnp.exp(s - m_new)
         m_ref[:] = m_new
         l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha + _dot(p.astype(v.dtype), v)
+        acc_ref[:] = acc_ref[:] * alpha + _bdot(p.astype(v.dtype), v)
 
-    @pl.when(j == pl.num_programs(3) - 1)
+    @pl.when(j == pl.num_programs(2) - 1)
     def _():
         l_safe = jnp.maximum(l_ref[:], 1e-30)
-        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0, 0] = m_ref[:] + jnp.log(l_safe)
+        o_ref[:] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[:] = m_ref[:] + jnp.log(l_safe)
 
 
-def _fwd(q, k, v, scale, block_q, block_k, interpret, causal=True):
-    """q (B,H,T,D), k/v (B,Hkv,S,D), Hkv | H -> out (B,H,T,D), lse (B,H,T,1)."""
-    B, H, T, D = q.shape
-    S = k.shape[2]
-    rep = H // k.shape[1]
+def _fwd(q, k, v, scale, block_q, block_k, g, interpret, causal=True):
+    """q (N, T, D) rows = flattened (B, H); k/v (Nkv, S, D) with
+    rep = N // Nkv -> out (N, T, D), lse (N, T, 1)."""
+    N, T, D = q.shape
+    S, Nkv = k.shape[1], k.shape[0]
+    rep = N // Nkv
     nq, nk = T // block_q, S // block_k
 
-    def kv_idx(b, h, i, j):
-        # GQA: query head h reads kv head h // rep — no materialized repeat.
-        # Skipped upper-triangle tiles clamp to the causal frontier so the
-        # revolving-buffer DMA sees an unchanged index (no fetch).
-        if not causal:
-            return (b, h // rep, j, 0)
-        return (b, h // rep,
-                jnp.minimum(j, _last_visible_kv(i, block_q, block_k)), 0)
-
+    kv_spec = _kv_spec(rep, g, block_q, block_k, D, causal)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, block_q=block_q,
                           block_k=block_k, causal=causal),
-        grid=(B, H, nq, nk),
+        grid=(N // g, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_k, D), kv_idx),
-            pl.BlockSpec((1, 1, block_k, D), kv_idx),
-        ],  # k/v arrays keep their Hkv head count; kv_idx maps the group
+            pl.BlockSpec((g, block_q, D), lambda r, i, j: (r, i, 0)),
+            kv_spec,
+            kv_spec,
+        ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((g, block_q, D), lambda r, i, j: (r, i, 0)),
             # trailing singleton lane dim: TPU blocks need the last two dims
             # (8,128)-divisible OR equal to the array dims; (bq, 1) with
             # array (..., T, 1) qualifies.
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((g, block_q, 1), lambda r, i, j: (r, i, 0)),
         ],
         out_shape=[
-            _sds((B, H, T, D), q.dtype, q),
-            _sds((B, H, T, 1), jnp.float32, q),
+            _sds((N, T, D), q.dtype, q),
+            _sds((N, T, 1), jnp.float32, q),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_q, D), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((g, block_q, D), jnp.float32),
+            pltpu.VMEM((g, block_q, 1), jnp.float32),
+            pltpu.VMEM((g, block_q, 1), jnp.float32),
         ],
         compiler_params=_SEMANTICS,
         interpret=interpret,
@@ -196,9 +225,9 @@ def _fwd(q, k, v, scale, block_q, block_k, interpret, causal=True):
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    dq_acc, *, scale, block_q, block_k, causal):
-    i, j = pl.program_id(2), pl.program_id(3)
+    i, j = pl.program_id(1), pl.program_id(2)
     last_j = _last_visible_kv(i, block_q, block_k) if causal \
-        else pl.num_programs(3) - 1
+        else pl.num_programs(2) - 1
 
     @pl.when(j == 0)
     def _():
@@ -206,24 +235,24 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(j <= last_j)
     def _():
-        q, k, v, do = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0], do_ref[0, 0]
-        s = _dot(q, k, trans_b=True) * scale
+        q, k, v, do = q_ref[:], k_ref[:], v_ref[:], do_ref[:]
+        s = _bdot(q, k, trans_b=True) * scale
         if causal:
             s = _mask_scores(s, i, j, block_q, block_k)
-        p = jnp.exp(s - lse_ref[0, 0])                  # (bq, bk) f32
-        dp = _dot(do, v, trans_b=True)
-        ds = p * (dp - delta_ref[0, 0])
-        dq_acc[:] = dq_acc[:] + _dot(ds.astype(k.dtype), k)
+        p = jnp.exp(s - lse_ref[:])                     # (g, bq, bk) f32
+        dp = _bdot(do, v, trans_b=True)
+        ds = p * (dp - delta_ref[:])
+        dq_acc[:] = dq_acc[:] + _bdot(ds.astype(k.dtype), k)
 
-    @pl.when(j == pl.num_programs(3) - 1)
+    @pl.when(j == pl.num_programs(2) - 1)
     def _():
-        dq_ref[0, 0] = (dq_acc[:] * scale).astype(dq_ref.dtype)
+        dq_ref[:] = (dq_acc[:] * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc, *, scale, block_q,
                     block_k, causal):
-    j, i = pl.program_id(2), pl.program_id(3)
+    j, i = pl.program_id(1), pl.program_id(2)
     first_i = _first_visible_q(j, block_q, block_k) if causal else 0
 
     @pl.when(i == 0)
@@ -233,112 +262,112 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(i >= first_i)
     def _():
-        q, k, v, do = q_ref[0, 0], k_ref[0, 0], v_ref[0, 0], do_ref[0, 0]
-        s = _dot(q, k, trans_b=True) * scale            # (bq, bk) f32
+        q, k, v, do = q_ref[:], k_ref[:], v_ref[:], do_ref[:]
+        s = _bdot(q, k, trans_b=True) * scale           # (g, bq, bk) f32
         if causal:
             s = _mask_scores(s, i, j, block_q, block_k)
-        p = jnp.exp(s - lse_ref[0, 0])
-        dv_acc[:] = dv_acc[:] + _dot_t(p.astype(do.dtype), do)
-        dp = _dot(do, v, trans_b=True)
-        ds = p * (dp - delta_ref[0, 0])
-        dk_acc[:] = dk_acc[:] + _dot_t(ds.astype(q.dtype), q)
+        p = jnp.exp(s - lse_ref[:])
+        dv_acc[:] = dv_acc[:] + _bdot_t(p.astype(do.dtype), do)
+        dp = _bdot(do, v, trans_b=True)
+        ds = p * (dp - delta_ref[:])
+        dk_acc[:] = dk_acc[:] + _bdot_t(ds.astype(q.dtype), q)
 
-    @pl.when(i == pl.num_programs(3) - 1)
+    @pl.when(i == pl.num_programs(2) - 1)
     def _():
-        dk_ref[0, 0] = (dk_acc[:] * scale).astype(dk_ref.dtype)
-        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+        dk_ref[:] = (dk_acc[:] * scale).astype(dk_ref.dtype)
+        dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd_impl(scale, block_q, block_k, interpret, causal, res, do,
+def _bwd_impl(scale, block_q, block_k, g, interpret, causal, res, do,
               dlse=None):
-    """Shared backward: dlse (B,H,T,1) is the cotangent of the logsumexp
+    """Shared backward: dlse (N, T, 1) is the cotangent of the logsumexp
     output when the caller differentiates through it (the ring merge does;
     plain flash_attention passes None). Math: with L = sum(do*out) +
     sum(dlse*lse), ds = p * (dp - delta + dlse) — i.e. dlse just shifts
     the per-row delta term, since d lse/d s_j = p_j."""
     q, k, v, out, lse = res
-    B, H, T, D = q.shape
-    S, Hkv = k.shape[2], k.shape[1]
-    rep = H // Hkv
+    N, T, D = q.shape
+    S, Nkv = k.shape[1], k.shape[0]
+    rep = N // Nkv
     nq, nk = T // block_q, S // block_k
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1, keepdims=True)                     # (B,H,T,1)
+                    axis=-1, keepdims=True)                     # (N, T, 1)
     if dlse is not None:
         delta = delta - dlse.astype(jnp.float32)
 
-    def kv_idx(b, h, i, j):
-        if not causal:
-            return (b, h // rep, j, 0)
-        return (b, h // rep,
-                jnp.minimum(j, _last_visible_kv(i, block_q, block_k)), 0)
+    kv_spec = _kv_spec(rep, g, block_q, block_k, D, causal)
 
-    def q_row(b, h, i, j):
-        return (b, h, i, 0)
+    def q_row(r, i, j):
+        return (r, i, 0)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, block_q=block_q,
                           block_k=block_k, causal=causal),
-        grid=(B, H, nq, nk),
+        grid=(N // g, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), q_row),
-            pl.BlockSpec((1, 1, block_k, D), kv_idx),
-            pl.BlockSpec((1, 1, block_k, D), kv_idx),
-            pl.BlockSpec((1, 1, block_q, D), q_row),
-            pl.BlockSpec((1, 1, block_q, 1), q_row),
-            pl.BlockSpec((1, 1, block_q, 1), q_row),
+            pl.BlockSpec((g, block_q, D), q_row),
+            kv_spec,
+            kv_spec,
+            pl.BlockSpec((g, block_q, D), q_row),
+            pl.BlockSpec((g, block_q, 1), q_row),
+            pl.BlockSpec((g, block_q, 1), q_row),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, D), q_row),
-        out_shape=_sds((B, H, T, D), q.dtype, q),
-        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        out_specs=pl.BlockSpec((g, block_q, D), q_row),
+        out_shape=_sds((N, T, D), q.dtype, q),
+        scratch_shapes=[pltpu.VMEM((g, block_q, D), jnp.float32)],
         compiler_params=_SEMANTICS,
         interpret=interpret,
     )(q, k, v, do, lse, delta)
 
-    def q_idx(b, h, j, i):
+    def q_idx(r, j, i):
         # clamp sub-frontier q tiles (skipped compute) to an already-visible
         # index so no fresh DMA is issued
-        if not causal:
-            return (b, h, i, 0)
-        return (b, h, jnp.maximum(i, _first_visible_q(j, block_q, block_k)),
-                0)
+        ic = i if not causal \
+            else jnp.maximum(i, _first_visible_q(j, block_q, block_k))
+        return (r, ic, 0)
 
-    def kv_row(b, h, j, i):
-        return (b, h // rep, j, 0)
+    # dkv grid is (row-group, kv-tile j, q-tile i): kv tiles are the
+    # resident operand (indexed by j directly, no causal clamp needed)
+    kv_block = (g if rep == 1 else 1, block_k, D)
+
+    def kv_row(r, j, i):
+        return (r if rep == 1 else r // rep, j, 0)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
                           block_k=block_k, causal=causal),
-        grid=(B, H, nk, nq),
+        grid=(N // g, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), q_idx),
-            pl.BlockSpec((1, 1, block_k, D), kv_row),
-            pl.BlockSpec((1, 1, block_k, D), kv_row),
-            pl.BlockSpec((1, 1, block_q, D), q_idx),
-            pl.BlockSpec((1, 1, block_q, 1), q_idx),
-            pl.BlockSpec((1, 1, block_q, 1), q_idx),
+            pl.BlockSpec((g, block_q, D), q_idx),
+            pl.BlockSpec(kv_block, kv_row),
+            pl.BlockSpec(kv_block, kv_row),
+            pl.BlockSpec((g, block_q, D), q_idx),
+            pl.BlockSpec((g, block_q, 1), q_idx),
+            pl.BlockSpec((g, block_q, 1), q_idx),
         ],
         out_specs=[
-            # per-QUERY-head dk/dv tiles (kv tiles are shared across the
-            # group, so writes would collide at the kv head count);
+            # per-QUERY-row dk/dv tiles (kv tiles are shared across a GQA
+            # group, so writes would collide at the kv row count);
             # group-summed below
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((g, block_k, D), lambda r, j, i: (r, j, 0)),
+            pl.BlockSpec((g, block_k, D), lambda r, j, i: (r, j, 0)),
         ],
         out_shape=[
-            _sds((B, H, S, D), k.dtype, q),
-            _sds((B, H, S, D), v.dtype, q),
+            _sds((N, S, D), k.dtype, q),
+            _sds((N, S, D), v.dtype, q),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_k, D), jnp.float32),
-            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((g, block_k, D), jnp.float32),
+            pltpu.VMEM((g, block_k, D), jnp.float32),
         ],
         compiler_params=_SEMANTICS,
         interpret=interpret,
     )(q, k, v, do, lse, delta)
     if rep > 1:
-        # jnp.repeat is interleaved: query head h <- kv head h // rep
-        dk = dk.reshape(B, Hkv, rep, S, D).sum(axis=2)
-        dv = dv.reshape(B, Hkv, rep, S, D).sum(axis=2)
+        # query rows r and r+1 ... sharing kv row r // rep are consecutive,
+        # so the group-sum is a plain reshape-reduce to the kv row count
+        dk = dk.reshape(Nkv, rep, S, D).sum(axis=1)
+        dv = dv.reshape(Nkv, rep, S, D).sum(axis=1)
     return dq, dk, dv
 
 
@@ -347,19 +376,19 @@ def _bwd_impl(scale, block_q, block_k, interpret, causal, res, do,
 # ignores lse, jax hands back a zero cotangent and the backward reduces
 # to plain FlashAttention-2).
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash_lse(q, k, v, scale, block_q, block_k, interpret, causal):
-    return _fwd(q, k, v, scale, block_q, block_k, interpret, causal)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_lse(q, k, v, scale, block_q, block_k, g, interpret, causal):
+    return _fwd(q, k, v, scale, block_q, block_k, g, interpret, causal)
 
 
-def _flash_lse_fwd(q, k, v, scale, block_q, block_k, interpret, causal):
-    out, lse = _fwd(q, k, v, scale, block_q, block_k, interpret, causal)
+def _flash_lse_fwd(q, k, v, scale, block_q, block_k, g, interpret, causal):
+    out, lse = _fwd(q, k, v, scale, block_q, block_k, g, interpret, causal)
     return (out, lse), (q, k, v, out, lse)
 
 
-def _flash_lse_bwd(scale, block_q, block_k, interpret, causal, res, cts):
+def _flash_lse_bwd(scale, block_q, block_k, g, interpret, causal, res, cts):
     do, dlse = cts
-    return _bwd_impl(scale, block_q, block_k, interpret, causal, res, do,
+    return _bwd_impl(scale, block_q, block_k, g, interpret, causal, res, do,
                      dlse=dlse)
 
 
@@ -395,6 +424,7 @@ def flash_attention_usable(q, k, v, *, causal: bool = True) -> bool:
 
 def flash_attention_lse(q, k, v, *, scale: float, causal: bool = True,
                         block_q: int = 0, block_k: int = 0,
+                        block_h: int = 0,
                         interpret: bool = False):
     """Flash attention returning (out, lse) over BTNH-layout tensors.
 
@@ -410,26 +440,31 @@ def flash_attention_lse(q, k, v, *, scale: float, causal: bool = True,
     S, nkv = k.shape[1], k.shape[2]
     assert hs % 8 == 0, "head dim must be a multiple of 8 (sublane)"
     assert nh % nkv == 0, "query heads must be a multiple of kv heads"
+    rep = nh // nkv
 
     block_q = block_q or _pick_block(T, DEFAULT_BLOCK_Q)
     block_k = block_k or _pick_block(S, DEFAULT_BLOCK_K)
     assert block_q and T % block_q == 0 and block_k and S % block_k == 0, (
         f"no usable block split for T={T}, S={S} — gate with "
         f"flash_attention_usable first")
+    g = block_h or _pick_group(B * nh, rep, DEFAULT_BLOCK_H)
+    assert (B * nh) % g == 0 and (g == 1 or rep == 1), (
+        f"row group {g} must divide B*nh={B * nh} and needs nh == n_kv")
 
-    # BTNH -> BHTD for tile-contiguous blocks
-    qt = jnp.transpose(q, (0, 2, 1, 3))
-    kt = jnp.transpose(k, (0, 2, 1, 3))
-    vt = jnp.transpose(v, (0, 2, 1, 3))
-    out, lse = _flash_lse(qt, kt, vt, float(scale), block_q, block_k,
+    # BTNH -> (B*H, T, D) row-major rows for group-blocked grids
+    qt = jnp.transpose(q, (0, 2, 1, 3)).reshape(B * nh, T, hs)
+    kt = jnp.transpose(k, (0, 2, 1, 3)).reshape(B * nkv, S, hs)
+    vt = jnp.transpose(v, (0, 2, 1, 3)).reshape(B * nkv, S, hs)
+    out, lse = _flash_lse(qt, kt, vt, float(scale), block_q, block_k, g,
                           interpret, causal)
-    return (jnp.transpose(out, (0, 2, 1, 3)),
-            jnp.transpose(lse[..., 0], (0, 2, 1)))
+    out = jnp.transpose(out.reshape(B, nh, T, hs), (0, 2, 1, 3))
+    lse = jnp.transpose(lse.reshape(B, nh, T), (0, 2, 1))
+    return out, lse
 
 
 def flash_attention(q, k, v, *, scale: float, causal: bool = True,
                     q_offset=0, block_q: int = 0, block_k: int = 0,
-                    interpret: bool = False) -> jnp.ndarray:
+                    block_h: int = 0, interpret: bool = False) -> jnp.ndarray:
     """Flash attention over BTNH-layout tensors.
 
     q: (B, T, nh, hs); k, v: (B, S, nkv, hs) with nkv | nh. `q_offset`
@@ -443,5 +478,5 @@ def flash_attention(q, k, v, *, scale: float, causal: bool = True,
         "offsets must use the naive path")
     out, _ = flash_attention_lse(q, k, v, scale=scale, causal=causal,
                                  block_q=block_q, block_k=block_k,
-                                 interpret=interpret)
+                                 block_h=block_h, interpret=interpret)
     return out

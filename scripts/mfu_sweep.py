@@ -124,6 +124,13 @@ def main():
                                             "FLASH_BLOCK_K": "512"}),
             (32, "pallas", False, "fused", {"FLASH_BLOCK_Q": "512",
                                             "FLASH_BLOCK_K": "512"}),
+            # row-group (B*H flattened) blocking: grid steps / block_h
+            (16, "pallas", False, "fused", {"FLASH_BLOCK_Q": "256",
+                                            "FLASH_BLOCK_K": "512",
+                                            "FLASH_BLOCK_H": "1"}),
+            (16, "pallas", False, "fused", {"FLASH_BLOCK_Q": "256",
+                                            "FLASH_BLOCK_K": "512",
+                                            "FLASH_BLOCK_H": "24"}),
             # streaming pallas CE (ops/fused_ce.py) vs the chunked scan
             (16, "xla", False, "pallas"),
             (16, "xla", False, "pallas", {"CE_BLOCK_N": "1024"}),

@@ -222,3 +222,44 @@ def test_rectangular_blocks_fwd_bwd(bq, bk):
     for a, b in zip(g_f, g_n):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("bh", [2, 4, 8])
+def test_row_group_blocking_fwd_bwd(bh):
+    """block_h > 1 batches several (batch, head) rows per grid step (the
+    grid-overhead fix, PERF.md round 4); MHA only — parity incl. grads."""
+    B, T, nh, hs = 2, 128, 4, 32
+    q, k, v = rand_qkv(jax.random.PRNGKey(7), B, T, T, nh, nh, hs)
+    scale = 1.0 / hs ** 0.5
+    w = jax.random.normal(jax.random.PRNGKey(8), q.shape)
+
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) * w)
+
+    flash = loss(lambda q, k, v: flash_attention(
+        q, k, v, scale=scale, block_q=64, block_k=64, block_h=bh,
+        interpret=True))
+    naive = loss(lambda q, k, v: _naive_sdpa(
+        q, k, v, scale=scale, q_offset=0, causal=True))
+    np.testing.assert_allclose(np.asarray(flash(q, k, v)),
+                               np.asarray(naive(q, k, v)),
+                               rtol=2e-4, atol=2e-4)
+    g_f = jax.grad(flash, argnums=(0, 1, 2))(q, k, v)
+    g_n = jax.grad(naive, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_f, g_n):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_row_group_defaults_to_one_for_gqa():
+    """GQA (rep > 1) must not group rows (kv tiles would need strides):
+    the default picks g=1 and an explicit block_h > 1 fails loudly."""
+    q, k, v = rand_qkv(jax.random.PRNGKey(9), 2, 64, 64, 4, 2, 32)
+    out = flash_attention(q, k, v, scale=0.18, block_q=32, block_k=32,
+                          interpret=True)  # default g -> 1, works
+    ref = _naive_sdpa(q, k, v, scale=0.18, q_offset=0, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    with pytest.raises(AssertionError):
+        flash_attention(q, k, v, scale=0.18, block_q=32, block_k=32,
+                        block_h=4, interpret=True)

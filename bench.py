@@ -35,25 +35,33 @@ _PROBE = (
 )
 
 
-def tpu_available(attempts: int = 2, timeout_s: int = 240) -> bool:
+def tpu_available(attempts: int = 4, timeout_s: int = 240,
+                  backoff_s: int = 30) -> tuple[bool, str]:
     """Probe TPU init + one compiled matmul in a throwaway subprocess so a
     wedged tunnel can't take the parent down. First TPU compile can take
-    ~20-40s; the timeout is generous."""
+    ~20-40s; the timeout is generous. Retries with backoff across the bench
+    budget (round-4 lesson: the tunnel drops and recovers on ~minutes
+    timescales). Returns (ok, last_error_tail) so a CPU-fallback bench line
+    can say WHY it is a proxy (VERDICT r4 #4: BENCH_r04's silent CPU number
+    was mistakable for a TPU result)."""
+    last_err = ""
     for i in range(attempts):
         try:
             r = subprocess.run([sys.executable, "-c", _PROBE],
                                capture_output=True, timeout=timeout_s)
             if r.returncode == 0:
-                return True
+                return True, ""
+            last_err = (f"probe rc={r.returncode}: "
+                        f"{r.stderr.decode(errors='replace')[-300:]}")
             sys.stderr.write(f"[bench] TPU probe {i + 1}/{attempts} failed "
-                             f"(rc={r.returncode}): "
-                             f"{r.stderr.decode()[-300:]}\n")
+                             f"({last_err})\n")
         except subprocess.TimeoutExpired:
-            sys.stderr.write(f"[bench] TPU probe {i + 1}/{attempts} timed "
-                             f"out after {timeout_s}s\n")
+            last_err = f"probe timed out after {timeout_s}s"
+            sys.stderr.write(f"[bench] TPU probe {i + 1}/{attempts} "
+                             f"{last_err}\n")
         if i + 1 < attempts:
-            time.sleep(10)
-    return False
+            time.sleep(backoff_s * (i + 1))
+    return False, last_err
 
 
 def run_bench(platform: str, only_recipe: str | None = None) -> dict:
@@ -198,7 +206,8 @@ def main() -> None:
         return
 
     out = None
-    if tpu_available():
+    tpu_ok, tpu_err = tpu_available()
+    if tpu_ok:
         if not (os.environ.get("BENCH_BATCH")
                 or os.environ.get("BENCH_REMAT")
                 or os.environ.get("BENCH_LOSS")
@@ -212,6 +221,10 @@ def main() -> None:
             candidates = []
             for name, env in (("batch16_flash_streamce",
                                {"BENCH_BATCH": "16", "BENCH_ATTN": "pallas",
+                                "BENCH_LOSS": "pallas"}),
+                              ("batch16_slab_streamce",
+                               {"BENCH_BATCH": "16", "BENCH_ATTN": "pallas",
+                                "FLASH_LAYOUT": "slab",
                                 "BENCH_LOSS": "pallas"}),
                               ("batch32_remat_pallas",
                                {"BENCH_BATCH": "32", "BENCH_REMAT": "1",
@@ -237,9 +250,19 @@ def main() -> None:
         sys.stderr.write("[bench] TPU unavailable -> CPU fallback\n")
     if out is None:
         out = _spawn_worker("cpu", timeout_s=1200)
+        if out is not None:
+            # Unmissable proxy marker: a CPU tok/s number must never read
+            # as a TPU result (VERDICT r4 weak #1). tpu_unavailable stays
+            # truthful: probe-ok-but-worker-crashed is a different failure
+            # (bench config bug, not tunnel down) and gets its own flag.
+            out["tpu_unavailable"] = not tpu_ok
+            out["tpu_worker_failed"] = tpu_ok
+            out["tpu_probe_error"] = tpu_err or "worker failed after probe ok"
+            out["metric"] = "cpu_proxy_tokens_per_sec_per_chip"
     if out is None:
         out = {"metric": "bench_error", "value": 0, "unit": "error",
-               "vs_baseline": 0,
+               "vs_baseline": 0, "tpu_unavailable": not tpu_ok,
+               "tpu_probe_error": tpu_err,
                "error": "all bench workers failed; see stderr"}
     print(json.dumps(out))
 

@@ -156,8 +156,6 @@ class LLMConfig:
             assert self.n_layer % self.pp_stages == 0, (
                 f"pp_stages {self.pp_stages} must divide n_layer "
                 f"{self.n_layer}")
-            assert not self.moe, \
-                "pipeline parallelism with MoE is not supported yet"
 
     @property
     def head_size(self) -> int:

@@ -35,6 +35,7 @@ from distributed_pytorch_tpu.config import LLMConfig
 from distributed_pytorch_tpu.models.attention import Attention, init_attn_cache
 from distributed_pytorch_tpu.models.mlp import MLP, MoE
 from distributed_pytorch_tpu.ops.losses import (fused_cross_entropy,
+                                                sp_fused_cross_entropy,
                                                 unchunked_cross_entropy)
 from distributed_pytorch_tpu.ops.rope import precompute_rope_freqs, slice_rows
 
@@ -57,7 +58,7 @@ class Block(nn.Module):
     remat_attn: bool = False
 
     @nn.compact
-    def __call__(self, x, freqs, cache=None, pos=0):
+    def __call__(self, x, freqs, cache=None, pos=0, stats_weight=None):
         cfg = self.config
         deterministic = self.deterministic
         ln1 = nn.LayerNorm(dtype=x.dtype, param_dtype=jnp.float32, name="ln1")
@@ -78,7 +79,8 @@ class Block(nn.Module):
         x = x + attn_out
         if cfg.moe:
             moe_out, aux_loss = MoE(cfg, name="moe")(
-                ln2(x), deterministic=deterministic)
+                ln2(x), deterministic=deterministic,
+                stats_weight=stats_weight)
             x = x + moe_out
         else:
             aux_loss = jnp.float32(0.0)
@@ -145,10 +147,9 @@ class LLM(nn.Module):
                     "(train/checkpoint.py unstacks the block params) to "
                     "sample from it")
             from distributed_pytorch_tpu.models.pipeline import run_pipeline
-            x = run_pipeline(self, cfg, self.attn_impl, deterministic,
-                             x, freqs)
+            x, total_aux = run_pipeline(self, cfg, self.attn_impl,
+                                        deterministic, x, freqs)
             new_caches = [None] * cfg.n_layer
-            total_aux = jnp.float32(0.0)
         else:
             if caches is None:
                 caches = [None] * cfg.n_layer
@@ -177,8 +178,8 @@ class LLM(nn.Module):
             # Weight-tied CE with ignore_index=-1 (reference :559-560, :689),
             # fp32-accumulated. The fused path never materializes the
             # (B, T, V) logits (ops/losses.py); under a live 'seq' axis the
-            # T dim is sequence-sharded (already /sp per device) and
-            # T-chunking would idle devices, so sp uses the unchunked path.
+            # chunk scan runs per-device over the local T shard inside
+            # shard_map (sp_fused_cross_entropy).
             from distributed_pytorch_tpu.parallel import context
             emb_mat = tkn_emb.embedding.astype(dt)  # (V, C)
             loss_impl = cfg.loss_impl
@@ -195,12 +196,28 @@ class LLM(nn.Module):
                 dp = mesh.shape.get("data", 1) if mesh is not None else 1
                 n_local = (x.shape[0] // dp) * x.shape[1]
                 if (context.seq_axis_size() <= 1 and tp == 1
+                        and x.shape[0] % dp == 0
                         and jax.default_backend() == "tpu"
                         and pallas_ce_usable(n_local, x.shape[-1], x.dtype)):
                     main_loss = pallas_cross_entropy(x, emb_mat, targets)
                 else:
                     loss_impl = "fused"
-            if loss_impl == "fused" and context.seq_axis_size() <= 1:
+            if loss_impl == "fused" and context.seq_axis_size() > 1:
+                # live 'seq' axis: chunk over the LOCAL T shard inside
+                # shard_map (ops/losses.py sp_fused_cross_entropy) instead
+                # of materializing seq-sharded full logits. Gates: no
+                # vocab-parallel embedding, B divisible by dp, T by sp.
+                mesh = context.get_mesh()
+                tp = mesh.shape.get("model", 1)
+                dp = mesh.shape.get("data", 1)
+                sp = context.seq_axis_size()
+                if (tp == 1 and x.shape[0] % dp == 0
+                        and x.shape[1] % sp == 0):
+                    main_loss = sp_fused_cross_entropy(
+                        x, emb_mat, targets, chunk=cfg.loss_chunk)
+                else:
+                    main_loss = unchunked_cross_entropy(x, emb_mat, targets)
+            elif loss_impl == "fused":
                 main_loss = fused_cross_entropy(
                     x, emb_mat, targets, chunk=cfg.loss_chunk)
             elif loss_impl != "pallas":

@@ -205,9 +205,15 @@ class MoE(nn.Module):
     config: LLMConfig
 
     @nn.compact
-    def __call__(self, x, *, deterministic: bool = True):
+    def __call__(self, x, *, deterministic: bool = True, stats_weight=None):
+        """`stats_weight` gates the cross-batch statistics (aux loss and the
+        aux-free bias update) without touching the token outputs: the
+        pipeline schedule (models/pipeline.py) passes 0.0 for buffer slots
+        holding no real microbatch so their deterministic zero-token routing
+        can't pollute the load balance. None/1.0 elsewhere."""
         cfg = self.config
         B, T, C = x.shape
+        sw = 1.0 if stats_weight is None else stats_weight
         up = cfg.up_dim
         n_exp, n_shared = cfg.n_exp, cfg.n_shared
         n_routed, k = cfg.n_routed, cfg.n_act_routed
@@ -251,9 +257,10 @@ class MoE(nn.Module):
             fi = jax.lax.stop_gradient(one_hot.sum(axis=(0, 1)) / n_tokens)
             if not deterministic and self.is_mutable_collection("moe_state"):
                 # online bias update toward uniform load (reference :466-470);
-                # fi here is over the GLOBAL batch under pjit.
+                # fi here is over the GLOBAL batch under pjit. `sw` zeroes
+                # the step for pipeline bubble slots.
                 delta = 1.0 / n_routed - fi
-                bias.value = bias.value + cfg.gamma * delta
+                bias.value = bias.value + cfg.gamma * delta * sw
             pi = jax.nn.softmax(router_logits, axis=1).mean(axis=0)
             aux_loss = cfg.alpha * n_routed * jnp.sum(pi * fi)
         else:
@@ -291,4 +298,4 @@ class MoE(nn.Module):
                                     combine.astype(dt))
 
         y = (shared_out + routed_out).reshape(B, T, C)
-        return y, aux_loss.astype(jnp.float32)
+        return y, aux_loss.astype(jnp.float32) * sw

@@ -24,9 +24,11 @@ instead of torch-style stage processes + P2P sends:
   of weights for every tick) and per-tick dropout rngs; gradients flow
   through scan, vmap, and roll with no custom VJPs.
 
-Not supported (asserted in config): MoE blocks (the aux-free bias is
-cross-tick mutable state) and KV-cached decoding (restore pipeline
-checkpoints with pp_stages=1 to sample; see train/checkpoint.py).
+MoE composes since round 5: the aux-free bias rides `nn.scan`'s
+`variable_carry` across ticks (per-layer-stacked by `nn.vmap`), and bubble
+slots are masked out of the load statistics (see _PipeTick). Still not
+supported: KV-cached decoding (restore pipeline checkpoints with
+pp_stages=1 to sample; see train/checkpoint.py).
 """
 
 from __future__ import annotations
@@ -64,17 +66,28 @@ def _pipe_constraint(t: jnp.ndarray) -> jnp.ndarray:
 class _PipeTick(nn.Module):
     """One pipeline tick: inject the incoming microbatch into slot 0, apply
     layer i to slot i for all i at once (vmapped Block), emit slot L-1 as a
-    finished microbatch, rotate the buffer."""
+    finished microbatch, rotate the buffer.
+
+    `tick` (scanned alongside the microbatch stream) marks which slots hold
+    a real microbatch: slot i is valid iff 0 <= tick - i < M. MoE blocks
+    get that validity as `stats_weight`, zeroing the aux loss and the
+    aux-free bias update for bubble slots whose all-zero tokens would
+    otherwise route deterministically and skew the load statistics."""
 
     config: LLMConfig
     attn_impl: str = "auto"
     deterministic: bool = True
+    n_microbatches: int = 1
 
     @nn.compact
-    def __call__(self, buf, x_in, freqs):
+    def __call__(self, buf, x_in, tick, freqs):
         from distributed_pytorch_tpu.models.gpt import Block
         cfg = self.config
+        L = cfg.n_layer
         buf = _pipe_constraint(buf.at[0].set(x_in))
+        slot_mb = tick - jnp.arange(L)                   # microbatch in slot i
+        valid = ((slot_mb >= 0) & (slot_mb < self.n_microbatches)
+                 ).astype(jnp.float32)                   # (L,)
         # both remat granularities apply per virtual stage, mirroring the
         # loop model (gpt.py): 'attn' via Block's own remat_attn, 'block'
         # by wrapping the vmapped Block
@@ -84,27 +97,38 @@ class _PipeTick(nn.Module):
             block_cls = nn.remat(Block, prevent_cse=False)
         VBlock = nn.vmap(
             block_cls,
-            variable_axes={"params": 0},
+            variable_axes={"params": 0, "moe_state": 0},
             split_rngs={"params": True, "dropout": True},
-            in_axes=(0, None),
+            in_axes=(0, None, None, None, 0),
             out_axes=(0, None, 0),
             axis_size=cfg.n_layer,
         )
-        # aux is (L,) but pp asserts non-MoE, so it is identically zero;
-        # cache is None (decoding is unsupported under pp)
-        y, _, _ = VBlock(cfg, self.attn_impl, self.deterministic, remat_attn,
-                         name="stack")(buf, freqs)
+        # cache is None (decoding is unsupported under pp); aux is (L,),
+        # already masked to valid slots via stats_weight
+        y, _, aux = VBlock(cfg, self.attn_impl, self.deterministic,
+                           remat_attn, name="stack")(buf, freqs, None, 0,
+                                                     valid)
         y = _pipe_constraint(y)
         out = y[-1]
-        return jnp.roll(y, 1, axis=0), out
+        return jnp.roll(y, 1, axis=0), (out, jnp.sum(aux))
 
 
 def run_pipeline(parent: nn.Module, cfg: LLMConfig, attn_impl: str,
                  deterministic: bool, x: jnp.ndarray,
-                 freqs) -> jnp.ndarray:
-    """Run the block stack as a pipeline. Must be called from inside the
-    LLM's @nn.compact __call__ (submodules are created against `parent`'s
-    scope, under the name 'blocks')."""
+                 freqs) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Run the block stack as a pipeline; returns (hidden, total_aux).
+
+    Must be called from inside the LLM's @nn.compact __call__ (submodules
+    are created against `parent`'s scope, under the name 'blocks').
+
+    MoE composition: `total_aux` is the sum over layers of the MEAN
+    per-microbatch aux loss — at M=1 bit-identical to the loop model's
+    full-batch aux; at M>1 the load statistics are per-microbatch, the
+    same granularity the reference's DDP training has per-rank (no aux
+    sync anywhere in kaggle-zero*.py). The aux-free bias likewise updates
+    once per (layer, microbatch) — M gamma-steps per optimizer step
+    instead of the loop model's one; bubble slots are masked out entirely
+    (stats_weight=0), so no zero-token routing pollutes either statistic."""
     B, T, C = x.shape
     L = cfg.n_layer
     M = cfg.pp_microbatches
@@ -124,16 +148,22 @@ def run_pipeline(parent: nn.Module, cfg: LLMConfig, attn_impl: str,
     ScanTick = nn.scan(
         _PipeTick,
         variable_broadcast="params",
+        variable_carry="moe_state",
         split_rngs={"params": False, "dropout": True},
-        in_axes=(0, nn.broadcast),
+        in_axes=(0, 0, nn.broadcast),
         out_axes=0,
         length=ticks,
     )
     buf0 = _pipe_constraint(jnp.zeros((L, b, T, C), x.dtype))
-    _, outs = ScanTick(cfg, attn_impl, deterministic,
-                       name="blocks", parent=parent)(buf0, xs_in, freqs)
-    # outs[t] is valid for t >= L-1: microbatch t-(L-1) fully processed
-    return outs[L - 1:].reshape(B, T, C)
+    _, (outs, aux_per_tick) = ScanTick(
+        cfg, attn_impl, deterministic, M,
+        name="blocks", parent=parent)(buf0, xs_in,
+                                      jnp.arange(ticks, dtype=jnp.int32),
+                                      freqs)
+    # outs[t] is valid for t >= L-1: microbatch t-(L-1) fully processed;
+    # aux_per_tick sums masked per-layer aux, so /M is the per-microbatch
+    # mean (see docstring)
+    return outs[L - 1:].reshape(B, T, C), jnp.sum(aux_per_tick) / M
 
 
 def stack_block_params(params: dict, n_layer: int) -> dict:

@@ -12,10 +12,10 @@ single-gpu/model.py:149). Implementations:
 * 'naive'  — explicit einsum path; supports attention-weight dropout, KV-cache
              offset masks, and arbitrary masks. Used for decode steps and as
              the reference semantics oracle in tests.
-* 'auto'   — pallas on TPU when shapes allow, else xla; naive when
-             dropout>0 (the fused paths have no weight-dropout, matching
-             the situation on CUDA where SDPA dropout exists — divergence
-             documented; default configs use dropout=0.0).
+* 'auto'   — pallas on TPU when shapes allow, else xla. dropout>0 routes
+             to the pallas kernel's IN-KERNEL dropout on TPU (round 5 —
+             parity with CUDA SDPA dropout, reference model.py:149-151);
+             non-flash shapes / non-TPU fall back to naive.
 
 Layout convention: q (B, T, nh, hs); k, v (B, S, n_kv, hs) — "BTNH", the
 layout jax.nn.dot_product_attention and the Pallas kernel both want, avoiding
@@ -162,8 +162,20 @@ def sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
             impl = "auto"  # shapes don't allow sp (e.g. decode steps)
 
     if use_dropout:
-        # only the naive path implements attention-weight dropout; honoring
-        # the caller's dropout beats honoring their impl choice
+        # the flash kernel applies attention-weight dropout IN-KERNEL
+        # (round-5: mask bits regenerated per tile, never in HBM) — the
+        # reference's fused-SDPA-with-dropout equivalent (model.py:149-151).
+        # XLA's fused attention has no dropout, so non-flash shapes fall to
+        # the naive einsum path; honoring the caller's dropout beats
+        # honoring their impl choice.
+        if impl in ("auto", "pallas") and _on_tpu():
+            from distributed_pytorch_tpu.ops.flash_attention import (
+                flash_attention, flash_attention_usable)
+            static_zero = isinstance(q_offset, int) and q_offset == 0
+            if static_zero and flash_attention_usable(q, k, v, causal=causal):
+                return flash_attention(q, k, v, scale=scale, causal=causal,
+                                       dropout_rate=dropout_rate,
+                                       dropout_rng=dropout_rng)
         impl = "naive"
     elif impl == "auto":
         # XLA's fused attention is at parity with the Pallas kernel for

@@ -61,6 +61,17 @@ DEFAULT_BLOCK_Q = int(os.environ.get("FLASH_BLOCK_Q", "256"))
 DEFAULT_BLOCK_K = int(os.environ.get("FLASH_BLOCK_K", "512"))
 DEFAULT_BLOCK_H = int(os.environ.get("FLASH_BLOCK_H", "8"))
 
+# Kernel layout (round 5): 'rows' flattens (B, H) into grid rows and needs
+# a BTNH -> (B*H, T, D) HBM transpose per operand per call — the profile's
+# 44 ms/step "layout copies" bucket (PERF.md r4). 'slab' reads the model's
+# natural (B, T, N*H) slabs directly (contiguous DMA, zero HBM transposes)
+# and relayouts head-major in VMEM; it also handles GQA in-kernel (no
+# materialized K/V repeat in HBM, group-sum of dk/dv at the write step).
+# Default stays 'rows' — the only layout that has compiled on real TPU
+# hardware so far — until the on-hardware sweep (mfu_sweep --variants
+# blocks, FLASH_LAYOUT legs) proves the slab path.
+DEFAULT_LAYOUT = os.environ.get("FLASH_LAYOUT", "rows")
+
 _NEG_INF = -1e30  # large-negative instead of -inf: keeps masked rows NaN-free
 
 _SEMANTICS = pltpu.CompilerParams(
@@ -101,6 +112,45 @@ def _bdot_t(a, b):
                                preferred_element_type=jnp.float32)
 
 
+def _dropout_bits(seed0, seed1, row0, q0, k0, shape):
+    """Counter-based uint32 hash (murmur3-finalizer style) keyed on the
+    ABSOLUTE (attention row, query position, key position) of every score
+    element plus the caller seed. Pure jnp int ops: runs identically in
+    the compiled kernel (VPU), in interpret mode (pltpu.prng_* has no CPU
+    lowering), and in plain host code (tests replay the exact mask for an
+    oracle comparison). Absolute-position keying makes the mask independent
+    of block sizes and of which kernel's grid order regenerates it."""
+    u32 = lambda a: jnp.asarray(a).astype(jnp.uint32)  # noqa: E731
+    row = u32(row0) + jax.lax.broadcasted_iota(jnp.uint32, shape, 0)
+    qp = u32(q0) + jax.lax.broadcasted_iota(jnp.uint32, shape, 1)
+    kp = u32(k0) + jax.lax.broadcasted_iota(jnp.uint32, shape, 2)
+    x = row * jnp.uint32(0x9E3779B1)
+    x = x ^ (qp * jnp.uint32(0x85EBCA6B))
+    x = x ^ (kp * jnp.uint32(0xC2B2AE35))
+    x = x ^ u32(seed0)
+    x = x + u32(seed1) * jnp.uint32(0x27D4EB2F)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    return x
+
+
+def _dropout_mask(seed_ref, r, i, j, shape, block_q: int, block_k: int,
+                  rate: float):
+    """Scaled keep-mask for one (g, block_q, block_k) score tile,
+    regenerated bit-identically in forward and both backward kernels.
+    P(drop) = rate via a uint32 threshold; survivors are pre-scaled by
+    1/(1-rate) (inverted dropout, the reference's
+    F.scaled_dot_product_attention semantics)."""
+    g = shape[0]
+    bits = _dropout_bits(seed_ref[0], seed_ref[1], r * g, i * block_q,
+                         j * block_k, shape)
+    thresh = jnp.uint32(min(int(rate * 2.0 ** 32), 2 ** 32 - 1))
+    return (bits >= thresh).astype(jnp.float32) / (1.0 - rate)
+
+
 def _sds(shape, dtype, like):
     """ShapeDtypeStruct carrying `like`'s varying-manual-axes set: pallas
     calls inside shard_map (the ring-attention hop path) must declare how
@@ -127,15 +177,52 @@ def _kv_spec(rep: int, g: int, block_q: int, block_k: int, D: int,
     return pl.BlockSpec((g if rep == 1 else 1, block_k, D), kv_idx)
 
 
-def _pick_group(n_rows: int, rep: int, preferred: int) -> int:
+# VMEM budget for one grid step's tiles + scratch + f32 score intermediates.
+# v5e has ~128 MiB VMEM/core; leave half for Mosaic's own buffers and
+# double-buffering slack so an oversized block/group config degrades (smaller
+# row group, or XLA fallback via the usable gate) instead of hard-failing
+# compilation with a Mosaic VMEM-exceeded error (round-4 ADVICE).
+_VMEM_BUDGET = int(os.environ.get("FLASH_VMEM_BUDGET_MB", "64")) * 2 ** 20
+
+
+def _vmem_bytes(g: int, gk: int, bq: int, bk: int, D: int,
+                dsize: int) -> int:
+    """Worst-case-kernel (dkv backward) VMEM estimate for one grid step:
+    double-buffered I/O tiles + f32 accumulator scratch + the f32 score/
+    prob/dscore intermediates the kernel body materializes."""
+    score = 3 * g * bq * bk * 4
+    fwd = (2 * (2 * g * bq * D + 2 * gk * bk * D) * dsize
+           + (g * bq * D + 2 * g * bq) * 4 + score)
+    bwd = (2 * (2 * g * bq * D + 2 * gk * bk * D + 2 * g * bk * D) * dsize
+           + 2 * g * bk * D * 4 + 4 * g * bq * 4 + score)
+    return max(fwd, bwd)
+
+
+def _pick_group(n_rows: int, rep: int, preferred: int,
+                block_q: int = 0, block_k: int = 0, D: int = 0,
+                dsize: int = 2) -> int:
     """Row-group size: a divisor of n_rows, 1 unless kv rows map 1:1
     (rep == 1 — with grouped rows a GQA group would need strided kv
-    tiles)."""
+    tiles). When block sizes are known, the group shrinks until the
+    per-step VMEM estimate fits the budget."""
     if rep != 1:
         return 1
     g = min(preferred, n_rows)
     while g > 1 and n_rows % g != 0:
         g -= 1
+    g = max(g, 1)
+    if block_q and block_k and D:
+        req = g
+        while g > 1 and _vmem_bytes(g, g, block_q, block_k, D,
+                                    dsize) > _VMEM_BUDGET:
+            g -= 1
+            while g > 1 and n_rows % g != 0:
+                g -= 1
+        if g != req:
+            import sys
+            print(f"[flash] row group shrunk {req} -> {g} to fit the "
+                  f"{_VMEM_BUDGET >> 20} MiB VMEM budget at blocks "
+                  f"({block_q}, {block_k})", file=sys.stderr)
     return max(g, 1)
 
 
@@ -143,9 +230,9 @@ def _pick_group(n_rows: int, rep: int, preferred: int) -> int:
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, scale, block_q, block_k, causal):
-    i, j = pl.program_id(1), pl.program_id(2)
+def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
+                m_ref, l_ref, *, scale, block_q, block_k, causal, rate):
+    r, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     last_j = _last_visible_kv(i, block_q, block_k) if causal \
         else pl.num_programs(2) - 1
 
@@ -169,7 +256,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
         m_ref[:] = m_new
+        # normalizer accumulates the UNdropped p (torch drops the
+        # already-normalized attention weights); only the value
+        # accumulation sees the mask
         l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if rate > 0.0:
+            p = p * _dropout_mask(seed_ref, r, i, j, p.shape, block_q,
+                                  block_k, rate)
         acc_ref[:] = acc_ref[:] * alpha + _bdot(p.astype(v.dtype), v)
 
     @pl.when(j == pl.num_programs(2) - 1)
@@ -179,9 +272,14 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         lse_ref[:] = m_ref[:] + jnp.log(l_safe)
 
 
-def _fwd(q, k, v, scale, block_q, block_k, g, interpret, causal=True):
+_SEED_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+def _fwd(q, k, v, seed, scale, block_q, block_k, g, interpret, causal=True,
+         rate=0.0):
     """q (N, T, D) rows = flattened (B, H); k/v (Nkv, S, D) with
-    rep = N // Nkv -> out (N, T, D), lse (N, T, 1)."""
+    rep = N // Nkv -> out (N, T, D), lse (N, T, 1). `seed` (2,) int32
+    feeds the in-kernel dropout PRNG (ignored at rate == 0)."""
     N, T, D = q.shape
     S, Nkv = k.shape[1], k.shape[0]
     rep = N // Nkv
@@ -190,9 +288,10 @@ def _fwd(q, k, v, scale, block_q, block_k, g, interpret, causal=True):
     kv_spec = _kv_spec(rep, g, block_q, block_k, D, causal)
     out, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, block_q=block_q,
-                          block_k=block_k, causal=causal),
+                          block_k=block_k, causal=causal, rate=rate),
         grid=(N // g, nq, nk),
         in_specs=[
+            _SEED_SPEC,
             pl.BlockSpec((g, block_q, D), lambda r, i, j: (r, i, 0)),
             kv_spec,
             kv_spec,
@@ -215,7 +314,7 @@ def _fwd(q, k, v, scale, block_q, block_k, g, interpret, causal=True):
         ],
         compiler_params=_SEMANTICS,
         interpret=interpret,
-    )(q, k, v)
+    )(seed, q, k, v)
     return out, lse
 
 
@@ -223,9 +322,10 @@ def _fwd(q, k, v, scale, block_q, block_k, g, interpret, causal=True):
 # backward (FlashAttention-2: recompute p from lse; delta = rowsum(do * o))
 # ---------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   dq_acc, *, scale, block_q, block_k, causal):
-    i, j = pl.program_id(1), pl.program_id(2)
+def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref, dq_acc, *, scale, block_q, block_k,
+                   causal, rate):
+    r, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     last_j = _last_visible_kv(i, block_q, block_k) if causal \
         else pl.num_programs(2) - 1
 
@@ -241,6 +341,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             s = _mask_scores(s, i, j, block_q, block_k)
         p = jnp.exp(s - lse_ref[:])                     # (g, bq, bk) f32
         dp = _bdot(do, v, trans_b=True)
+        if rate > 0.0:
+            # dS = P*(M/(1-r)*(dO V^T) - delta): rowsum(dP*P) still equals
+            # rowsum(dO*O) = delta because O was computed with the SAME mask
+            dp = dp * _dropout_mask(seed_ref, r, i, j, dp.shape, block_q,
+                                    block_k, rate)
         ds = p * (dp - delta_ref[:])
         dq_acc[:] = dq_acc[:] + _bdot(ds.astype(k.dtype), k)
 
@@ -249,10 +354,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[:] = (dq_acc[:] * scale).astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, block_q,
-                    block_k, causal):
-    j, i = pl.program_id(1), pl.program_id(2)
+def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *, scale,
+                    block_q, block_k, causal, rate):
+    r, j, i = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     first_i = _first_visible_q(j, block_q, block_k) if causal else 0
 
     @pl.when(i == 0)
@@ -267,8 +372,16 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             s = _mask_scores(s, i, j, block_q, block_k)
         p = jnp.exp(s - lse_ref[:])
-        dv_acc[:] = dv_acc[:] + _bdot_t(p.astype(do.dtype), do)
-        dp = _bdot(do, v, trans_b=True)
+        if rate > 0.0:
+            # same (r, i, j) seeding as forward/dq — canonical coords, not
+            # this kernel's transposed grid order
+            mask = _dropout_mask(seed_ref, r, i, j, p.shape, block_q,
+                                 block_k, rate)
+            dv_acc[:] = dv_acc[:] + _bdot_t((p * mask).astype(do.dtype), do)
+            dp = _bdot(do, v, trans_b=True) * mask
+        else:
+            dv_acc[:] = dv_acc[:] + _bdot_t(p.astype(do.dtype), do)
+            dp = _bdot(do, v, trans_b=True)
         ds = p * (dp - delta_ref[:])
         dk_acc[:] = dk_acc[:] + _bdot_t(ds.astype(q.dtype), q)
 
@@ -278,14 +391,14 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _bwd_impl(scale, block_q, block_k, g, interpret, causal, res, do,
+def _bwd_impl(scale, block_q, block_k, g, interpret, causal, rate, res, do,
               dlse=None):
     """Shared backward: dlse (N, T, 1) is the cotangent of the logsumexp
     output when the caller differentiates through it (the ring merge does;
     plain flash_attention passes None). Math: with L = sum(do*out) +
     sum(dlse*lse), ds = p * (dp - delta + dlse) — i.e. dlse just shifts
     the per-row delta term, since d lse/d s_j = p_j."""
-    q, k, v, out, lse = res
+    q, k, v, seed, out, lse = res
     N, T, D = q.shape
     S, Nkv = k.shape[1], k.shape[0]
     rep = N // Nkv
@@ -302,9 +415,10 @@ def _bwd_impl(scale, block_q, block_k, g, interpret, causal, res, do,
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, block_q=block_q,
-                          block_k=block_k, causal=causal),
+                          block_k=block_k, causal=causal, rate=rate),
         grid=(N // g, nq, nk),
         in_specs=[
+            _SEED_SPEC,
             pl.BlockSpec((g, block_q, D), q_row),
             kv_spec,
             kv_spec,
@@ -317,7 +431,7 @@ def _bwd_impl(scale, block_q, block_k, g, interpret, causal, res, do,
         scratch_shapes=[pltpu.VMEM((g, block_q, D), jnp.float32)],
         compiler_params=_SEMANTICS,
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(seed, q, k, v, do, lse, delta)
 
     def q_idx(r, j, i):
         # clamp sub-frontier q tiles (skipped compute) to an already-visible
@@ -335,9 +449,10 @@ def _bwd_impl(scale, block_q, block_k, g, interpret, causal, res, do,
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
-                          block_k=block_k, causal=causal),
+                          block_k=block_k, causal=causal, rate=rate),
         grid=(N // g, nk, nq),
         in_specs=[
+            _SEED_SPEC,
             pl.BlockSpec((g, block_q, D), q_idx),
             pl.BlockSpec(kv_block, kv_row),
             pl.BlockSpec(kv_block, kv_row),
@@ -362,34 +477,355 @@ def _bwd_impl(scale, block_q, block_k, g, interpret, causal, res, do,
         ],
         compiler_params=_SEMANTICS,
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(seed, q, k, v, do, lse, delta)
     if rep > 1:
         # query rows r and r+1 ... sharing kv row r // rep are consecutive,
         # so the group-sum is a plain reshape-reduce to the kv row count
         dk = dk.reshape(Nkv, rep, S, D).sum(axis=1)
         dv = dv.reshape(Nkv, rep, S, D).sum(axis=1)
-    return dq, dk, dv
+    return dq, dk, dv, None  # seed (int32) gets no cotangent
+
+
+# ---------------------------------------------------------------------------
+# slab layout: kernels read (B, T, N*H) directly — no HBM transposes
+# ---------------------------------------------------------------------------
+
+def _load_hbd(ref, n: int, D: int, rep: int = 1):
+    """(1, t, n*D) ref -> (n*rep, t, D) head-major tile: the VMEM relayout
+    that replaces the rows layout's per-call HBM transpose. GQA expands the
+    kv heads here, in VMEM, where the repeat costs bandwidth the MXU pass
+    was going to spend anyway — never in HBM."""
+    t = ref[0].reshape(ref.shape[1], n, D).transpose(1, 0, 2)
+    if rep > 1:
+        t = jnp.repeat(t, rep, axis=0)
+    return t
+
+
+def _slab_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
+                     m_ref, l_ref, *, scale, block_q, block_k, nh, nkv, D,
+                     causal, rate):
+    b, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    last_j = _last_visible_kv(i, block_q, block_k) if causal \
+        else pl.num_programs(2) - 1
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    @pl.when(j <= last_j)
+    def _():
+        q = _load_hbd(q_ref, nh, D)
+        k = _load_hbd(k_ref, nkv, D, nh // nkv)
+        v = _load_hbd(v_ref, nkv, D, nh // nkv)
+        s = _bdot(q, k, trans_b=True) * scale           # (nh, bq, bk) f32
+        if causal:
+            s = _mask_scores(s, i, j, block_q, block_k)
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        if rate > 0.0:
+            # row0 = b*nh: same absolute-row keying as the rows layout, so
+            # the two layouts draw identical masks
+            p = p * _dropout_mask(seed_ref, b, i, j, p.shape, block_q,
+                                  block_k, rate)
+        acc_ref[:] = acc_ref[:] * alpha + _bdot(p.astype(v.dtype), v)
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _():
+        l_safe = jnp.maximum(l_ref[:], 1e-30)
+        o = acc_ref[:] / l_safe                         # (nh, bq, D)
+        o_ref[0] = o.transpose(1, 0, 2).reshape(
+            o.shape[1], nh * D).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:, :, 0] + jnp.log(l_safe[:, :, 0])).T
+
+
+def _slab_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                        delta_ref, dq_ref, dq_acc, *, scale, block_q,
+                        block_k, nh, nkv, D, causal, rate):
+    b, i, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    last_j = _last_visible_kv(i, block_q, block_k) if causal \
+        else pl.num_programs(2) - 1
+
+    @pl.when(j == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    @pl.when(j <= last_j)
+    def _():
+        q = _load_hbd(q_ref, nh, D)
+        k = _load_hbd(k_ref, nkv, D, nh // nkv)
+        v = _load_hbd(v_ref, nkv, D, nh // nkv)
+        do = _load_hbd(do_ref, nh, D)
+        lse = lse_ref[0].T[:, :, None]                  # (nh, bq, 1)
+        delta = delta_ref[0].T[:, :, None]
+        s = _bdot(q, k, trans_b=True) * scale
+        if causal:
+            s = _mask_scores(s, i, j, block_q, block_k)
+        p = jnp.exp(s - lse)
+        dp = _bdot(do, v, trans_b=True)
+        if rate > 0.0:
+            dp = dp * _dropout_mask(seed_ref, b, i, j, dp.shape, block_q,
+                                    block_k, rate)
+        ds = p * (dp - delta)
+        dq_acc[:] = dq_acc[:] + _bdot(ds.astype(k.dtype), k)
+
+    @pl.when(j == pl.num_programs(2) - 1)
+    def _():
+        dq = (dq_acc[:] * scale).transpose(1, 0, 2)
+        dq_ref[0] = dq.reshape(dq.shape[0], nh * D).astype(dq_ref.dtype)
+
+
+def _slab_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                         delta_ref, dk_ref, dv_ref, dk_acc, dv_acc, *,
+                         scale, block_q, block_k, nh, nkv, D, causal, rate):
+    b, j, i = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    first_i = _first_visible_q(j, block_q, block_k) if causal else 0
+    rep = nh // nkv
+
+    @pl.when(i == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    @pl.when(i >= first_i)
+    def _():
+        q = _load_hbd(q_ref, nh, D)
+        k = _load_hbd(k_ref, nkv, D, rep)
+        v = _load_hbd(v_ref, nkv, D, rep)
+        do = _load_hbd(do_ref, nh, D)
+        lse = lse_ref[0].T[:, :, None]
+        delta = delta_ref[0].T[:, :, None]
+        s = _bdot(q, k, trans_b=True) * scale
+        if causal:
+            s = _mask_scores(s, i, j, block_q, block_k)
+        p = jnp.exp(s - lse)
+        if rate > 0.0:
+            mask = _dropout_mask(seed_ref, b, i, j, p.shape, block_q,
+                                 block_k, rate)
+            dv_acc[:] = dv_acc[:] + _bdot_t((p * mask).astype(do.dtype), do)
+            dp = _bdot(do, v, trans_b=True) * mask
+        else:
+            dv_acc[:] = dv_acc[:] + _bdot_t(p.astype(do.dtype), do)
+            dp = _bdot(do, v, trans_b=True)
+        ds = p * (dp - delta)
+        dk_acc[:] = dk_acc[:] + _bdot_t(ds.astype(q.dtype), q)
+
+    @pl.when(i == pl.num_programs(2) - 1)
+    def _():
+        dk = dk_acc[:] * scale                          # (nh, bk, D)
+        dv = dv_acc[:]
+        if rep > 1:
+            # GQA group-sum folded into the write step (the rows layout
+            # does this host-side over per-query-row HBM outputs)
+            dk = dk.reshape(nkv, rep, dk.shape[1], D).sum(axis=1)
+            dv = dv.reshape(nkv, rep, dv.shape[1], D).sum(axis=1)
+        dk_ref[0] = dk.transpose(1, 0, 2).reshape(
+            dk.shape[1], nkv * D).astype(dk_ref.dtype)
+        dv_ref[0] = dv.transpose(1, 0, 2).reshape(
+            dv.shape[1], nkv * D).astype(dv_ref.dtype)
+
+
+def _slab_fwd(q, k, v, seed, scale, block_q, block_k, interpret,
+              causal, rate, nh, nkv, D):
+    """q (B, T, nh*D) slabs; k/v (B, S, nkv*D) -> out (B, T, nh*D),
+    lse (B, T, nh)."""
+    B, T, _ = q.shape
+    S = k.shape[1]
+    nq, nk = T // block_q, S // block_k
+
+    def q_row(b, i, j):
+        return (b, i, 0)
+
+    def kv_row(b, i, j):
+        jc = j if not causal \
+            else jnp.minimum(j, _last_visible_kv(i, block_q, block_k))
+        return (b, jc, 0)
+
+    return pl.pallas_call(
+        functools.partial(_slab_fwd_kernel, scale=scale, block_q=block_q,
+                          block_k=block_k, nh=nh, nkv=nkv, D=D,
+                          causal=causal, rate=rate),
+        grid=(B, nq, nk),
+        in_specs=[
+            _SEED_SPEC,
+            pl.BlockSpec((1, block_q, nh * D), q_row),
+            pl.BlockSpec((1, block_k, nkv * D), kv_row),
+            pl.BlockSpec((1, block_k, nkv * D), kv_row),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, nh * D), q_row),
+            pl.BlockSpec((1, block_q, nh), q_row),
+        ],
+        out_shape=[
+            _sds((B, T, nh * D), q.dtype, q),
+            _sds((B, T, nh), jnp.float32, q),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((nh, block_q, D), jnp.float32),
+            pltpu.VMEM((nh, block_q, 1), jnp.float32),
+            pltpu.VMEM((nh, block_q, 1), jnp.float32),
+        ],
+        compiler_params=_SEMANTICS,
+        interpret=interpret,
+    )(seed, q, k, v)
+
+
+def _slab_bwd(scale, block_q, block_k, interpret, causal, rate, nh, nkv, D,
+              res, do, dlse=None):
+    q, k, v, seed, out, lse = res
+    B, T, _ = q.shape
+    S = k.shape[1]
+    nq, nk = T // block_q, S // block_k
+    do3 = do.reshape(B, T, nh, D).astype(jnp.float32)
+    out3 = out.reshape(B, T, nh, D).astype(jnp.float32)
+    delta = jnp.sum(do3 * out3, axis=-1)                # (B, T, nh) f32
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
+
+    def q_row(b, i, j):
+        return (b, i, 0)
+
+    def kv_clamped(b, i, j):
+        jc = j if not causal \
+            else jnp.minimum(j, _last_visible_kv(i, block_q, block_k))
+        return (b, jc, 0)
+
+    dq = pl.pallas_call(
+        functools.partial(_slab_bwd_dq_kernel, scale=scale, block_q=block_q,
+                          block_k=block_k, nh=nh, nkv=nkv, D=D,
+                          causal=causal, rate=rate),
+        grid=(B, nq, nk),
+        in_specs=[
+            _SEED_SPEC,
+            pl.BlockSpec((1, block_q, nh * D), q_row),
+            pl.BlockSpec((1, block_k, nkv * D), kv_clamped),
+            pl.BlockSpec((1, block_k, nkv * D), kv_clamped),
+            pl.BlockSpec((1, block_q, nh * D), q_row),
+            pl.BlockSpec((1, block_q, nh), q_row),
+            pl.BlockSpec((1, block_q, nh), q_row),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, nh * D), q_row),
+        out_shape=_sds((B, T, nh * D), q.dtype, q),
+        scratch_shapes=[pltpu.VMEM((nh, block_q, D), jnp.float32)],
+        compiler_params=_SEMANTICS,
+        interpret=interpret,
+    )(seed, q, k, v, do, lse, delta)
+
+    def kv_row(b, j, i):
+        return (b, j, 0)
+
+    def q_clamped(b, j, i):
+        ic = i if not causal \
+            else jnp.maximum(i, _first_visible_q(j, block_q, block_k))
+        return (b, ic, 0)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_slab_bwd_dkv_kernel, scale=scale,
+                          block_q=block_q, block_k=block_k, nh=nh, nkv=nkv,
+                          D=D, causal=causal, rate=rate),
+        grid=(B, nk, nq),
+        in_specs=[
+            _SEED_SPEC,
+            pl.BlockSpec((1, block_q, nh * D), q_clamped),
+            pl.BlockSpec((1, block_k, nkv * D), kv_row),
+            pl.BlockSpec((1, block_k, nkv * D), kv_row),
+            pl.BlockSpec((1, block_q, nh * D), q_clamped),
+            pl.BlockSpec((1, block_q, nh), q_clamped),
+            pl.BlockSpec((1, block_q, nh), q_clamped),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, nkv * D), kv_row),
+            pl.BlockSpec((1, block_k, nkv * D), kv_row),
+        ],
+        out_shape=[
+            _sds((B, S, nkv * D), k.dtype, q),
+            _sds((B, S, nkv * D), v.dtype, q),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((nh, block_k, D), jnp.float32),
+            pltpu.VMEM((nh, block_k, D), jnp.float32),
+        ],
+        compiler_params=_SEMANTICS,
+        interpret=interpret,
+    )(seed, q, k, v, do, lse, delta)
+    return dq, dk, dv, None
+
+
+def _make_slab_lse(nh: int, nkv: int, D: int):
+    """custom_vjp closure over the static head geometry (cached per
+    geometry via _slab_lse_for so jit tracing reuses one vjp instance)."""
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+    def slab_lse(q, k, v, seed, scale, block_q, block_k, interpret, causal,
+                 rate):
+        return _slab_fwd(q, k, v, seed, scale, block_q, block_k, interpret,
+                         causal, rate, nh, nkv, D)
+
+    def fwd(q, k, v, seed, scale, block_q, block_k, interpret, causal,
+            rate):
+        out, lse = _slab_fwd(q, k, v, seed, scale, block_q, block_k,
+                             interpret, causal, rate, nh, nkv, D)
+        return (out, lse), (q, k, v, seed, out, lse)
+
+    def bwd(scale, block_q, block_k, interpret, causal, rate, res, cts):
+        do, dlse = cts
+        return _slab_bwd(scale, block_q, block_k, interpret, causal, rate,
+                         nh, nkv, D, res, do, dlse=dlse)
+
+    slab_lse.defvjp(fwd, bwd)
+    return slab_lse
+
+
+@functools.lru_cache(maxsize=64)
+def _slab_lse_for(nh: int, nkv: int, D: int):
+    return _make_slab_lse(nh, nkv, D)
+
+
+def slab_attention_usable(B, T, S, nh, nkv, hs, dtype,
+                          block_q: int = 0, block_k: int = 0) -> bool:
+    """Gate for the slab layout: lane-aligned head slabs ((n*hs) % 128),
+    sublane-aligned blocks, and the (nh, bq, bk) f32 score tile + scratch
+    within the VMEM budget."""
+    if (nh * hs) % 128 != 0 or (nkv * hs) % 128 != 0 or hs % 8 != 0:
+        return False
+    bq = block_q or _pick_block(T, DEFAULT_BLOCK_Q)
+    bk = block_k or _pick_block(S, DEFAULT_BLOCK_K)
+    if not (bq and bk):
+        return False
+    dsize = jnp.dtype(dtype).itemsize
+    return _vmem_bytes(nh, nkv, bq, bk, hs, dsize) <= _VMEM_BUDGET
 
 
 # One custom_vjp serves both public entries: (out, lse) with the lse
 # output differentiable (the ring merge needs d/dlse; when a caller
 # ignores lse, jax hands back a zero cotangent and the backward reduces
-# to plain FlashAttention-2).
+# to plain FlashAttention-2). `seed` is a traced (2,) int32 operand (no
+# cotangent); `rate` is static.
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash_lse(q, k, v, scale, block_q, block_k, g, interpret, causal):
-    return _fwd(q, k, v, scale, block_q, block_k, g, interpret, causal)
-
-
-def _flash_lse_fwd(q, k, v, scale, block_q, block_k, g, interpret, causal):
-    out, lse = _fwd(q, k, v, scale, block_q, block_k, g, interpret, causal)
-    return (out, lse), (q, k, v, out, lse)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _flash_lse(q, k, v, seed, scale, block_q, block_k, g, interpret,
+               causal, rate):
+    return _fwd(q, k, v, seed, scale, block_q, block_k, g, interpret,
+                causal, rate)
 
 
-def _flash_lse_bwd(scale, block_q, block_k, g, interpret, causal, res, cts):
+def _flash_lse_fwd(q, k, v, seed, scale, block_q, block_k, g, interpret,
+                   causal, rate):
+    out, lse = _fwd(q, k, v, seed, scale, block_q, block_k, g, interpret,
+                    causal, rate)
+    return (out, lse), (q, k, v, seed, out, lse)
+
+
+def _flash_lse_bwd(scale, block_q, block_k, g, interpret, causal, rate,
+                   res, cts):
     do, dlse = cts
-    return _bwd_impl(scale, block_q, block_k, g, interpret, causal, res, do,
-                     dlse=dlse)
+    return _bwd_impl(scale, block_q, block_k, g, interpret, causal, rate,
+                     res, do, dlse=dlse)
 
 
 _flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
@@ -418,13 +854,24 @@ def flash_attention_usable(q, k, v, *, causal: bool = True) -> bool:
         return False  # decode-step shapes: the naive path is fine
     if hs % 8 != 0:
         return False
-    return bool(_pick_block(T, DEFAULT_BLOCK_Q)
-                and _pick_block(S, DEFAULT_BLOCK_K))
+    bq = _pick_block(T, DEFAULT_BLOCK_Q)
+    bk = _pick_block(S, DEFAULT_BLOCK_K)
+    if not (bq and bk):
+        return False
+    # even a group of 1 must fit the per-step VMEM budget
+    dsize = jnp.dtype(q.dtype).itemsize
+    rows_ok = _vmem_bytes(1, 1, bq, bk, hs, dsize) <= _VMEM_BUDGET
+    if DEFAULT_LAYOUT == "slab":
+        nkv = k.shape[2]
+        return rows_ok or slab_attention_usable(B, T, S, nh, nkv, hs,
+                                                q.dtype)
+    return rows_ok
 
 
 def flash_attention_lse(q, k, v, *, scale: float, causal: bool = True,
                         block_q: int = 0, block_k: int = 0,
-                        block_h: int = 0,
+                        block_h: int = 0, layout: str | None = None,
+                        dropout_rate: float = 0.0, dropout_rng=None,
                         interpret: bool = False):
     """Flash attention returning (out, lse) over BTNH-layout tensors.
 
@@ -435,6 +882,15 @@ def flash_attention_lse(q, k, v, *, scale: float, causal: bool = True,
     a normalized partial (out_c, lse_c) pair and the merge is plain jnp.
     `causal=False` computes full (unmasked) attention — the visible
     off-diagonal chunks of a causal ring.
+
+    `dropout_rate` > 0 applies attention-weight dropout INSIDE the kernel
+    (reference model.py:149-151 SDPA dropout): normalized weights are
+    masked/rescaled via the TPU per-core PRNG, reseeded per score tile
+    from `dropout_rng` so forward and backward regenerate identical bits
+    (no mask tensor ever exists in HBM). NOTE: lse is computed from the
+    UNdropped scores (it is the true logsumexp); the ring merge therefore
+    composes with dropout only per-chunk, which is why the sp path keeps
+    dropout disabled (ops/attention_core.py).
     """
     B, T, nh, hs = q.shape
     S, nkv = k.shape[1], k.shape[2]
@@ -447,7 +903,31 @@ def flash_attention_lse(q, k, v, *, scale: float, causal: bool = True,
     assert block_q and T % block_q == 0 and block_k and S % block_k == 0, (
         f"no usable block split for T={T}, S={S} — gate with "
         f"flash_attention_usable first")
-    g = block_h or _pick_group(B * nh, rep, DEFAULT_BLOCK_H)
+
+    rate = float(dropout_rate)
+    if rate > 0.0:
+        assert dropout_rng is not None, \
+            "dropout_rate > 0 requires a dropout_rng key"
+        assert rate < 1.0
+        seed = jax.random.randint(dropout_rng, (2,), -2 ** 31, 2 ** 31 - 1,
+                                  jnp.int32)
+    else:
+        seed = jnp.zeros((2,), jnp.int32)
+
+    if layout is None:
+        layout = DEFAULT_LAYOUT
+    if layout == "slab" and slab_attention_usable(
+            B, T, S, nh, nkv, hs, q.dtype, block_q, block_k):
+        # (B, T, N, H) -> (B, T, N*H) is a FREE reshape of the model's
+        # natural layout: zero HBM transposes in or out
+        fn = _slab_lse_for(nh, nkv, hs)
+        out, lse = fn(q.reshape(B, T, nh * hs), k.reshape(B, S, nkv * hs),
+                      v.reshape(B, S, nkv * hs), seed, float(scale),
+                      block_q, block_k, interpret, causal, rate)
+        return out.reshape(B, T, nh, hs), lse
+
+    g = block_h or _pick_group(B * nh, rep, DEFAULT_BLOCK_H, block_q,
+                               block_k, hs, jnp.dtype(q.dtype).itemsize)
     assert (B * nh) % g == 0 and (g == 1 or rep == 1), (
         f"row group {g} must divide B*nh={B * nh} and needs nh == n_kv")
 
@@ -455,8 +935,8 @@ def flash_attention_lse(q, k, v, *, scale: float, causal: bool = True,
     qt = jnp.transpose(q, (0, 2, 1, 3)).reshape(B * nh, T, hs)
     kt = jnp.transpose(k, (0, 2, 1, 3)).reshape(B * nkv, S, hs)
     vt = jnp.transpose(v, (0, 2, 1, 3)).reshape(B * nkv, S, hs)
-    out, lse = _flash_lse(qt, kt, vt, float(scale), block_q, block_k, g,
-                          interpret, causal)
+    out, lse = _flash_lse(qt, kt, vt, seed, float(scale), block_q, block_k,
+                          g, interpret, causal, rate)
     out = jnp.transpose(out.reshape(B, nh, T, hs), (0, 2, 1, 3))
     lse = jnp.transpose(lse.reshape(B, nh, T), (0, 2, 1))
     return out, lse
@@ -464,19 +944,25 @@ def flash_attention_lse(q, k, v, *, scale: float, causal: bool = True,
 
 def flash_attention(q, k, v, *, scale: float, causal: bool = True,
                     q_offset=0, block_q: int = 0, block_k: int = 0,
-                    block_h: int = 0, interpret: bool = False) -> jnp.ndarray:
+                    block_h: int = 0, layout: str | None = None,
+                    dropout_rate: float = 0.0, dropout_rng=None,
+                    interpret: bool = False) -> jnp.ndarray:
     """Flash attention over BTNH-layout tensors.
 
     q: (B, T, nh, hs); k, v: (B, S, nkv, hs) with nkv | nh. `q_offset`
     must be a static 0 (prefill/training; the dispatcher routes
     cached-decode offsets — including traced ones — to the naive path).
     GQA kv heads are shared via the kernel's index maps; K/V are never
-    materialized per query head.
+    materialized per query head. `dropout_rate`/`dropout_rng` enable
+    in-kernel attention-weight dropout (see flash_attention_lse).
     """
     assert isinstance(q_offset, int) and q_offset == 0, (
         "flash kernel requires a static q_offset == 0; cached-decode "
         "offsets must use the naive path")
     out, _ = flash_attention_lse(q, k, v, scale=scale, causal=causal,
                                  block_q=block_q, block_k=block_k,
-                                 block_h=block_h, interpret=interpret)
+                                 block_h=block_h, layout=layout,
+                                 dropout_rate=dropout_rate,
+                                 dropout_rng=dropout_rng,
+                                 interpret=interpret)
     return out

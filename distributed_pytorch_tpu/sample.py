@@ -105,6 +105,11 @@ def main(argv=None) -> None:
         # (models/pipeline.py — pp doesn't support KV caches itself)
         from distributed_pytorch_tpu.models.pipeline import unstack_block_params
         params = unstack_block_params(params, model_cfg.n_layer)
+        if state.moe_state:
+            # the aux-free bias is layer-stacked under pp too
+            state = dataclasses.replace(
+                state, moe_state=unstack_block_params(state.moe_state,
+                                                      model_cfg.n_layer))
         model_cfg = dataclasses.replace(model_cfg, pp_stages=1,
                                         pp_microbatches=0)
         model = build_model(model_cfg, train_cfg)

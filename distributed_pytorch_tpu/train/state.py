@@ -135,10 +135,15 @@ def init_train_state(rng: jax.Array, model: LLM, model_cfg: LLMConfig,
         variables = loop_model.init({"params": rng, "dropout": rng},
                                     dummy, dummy)
         params = stack_block_params(variables["params"], model_cfg.n_layer)
+        moe_state = variables.get("moe_state", {})
+        if moe_state:
+            # same restack for the aux-free bias: the pipeline's nn.vmap
+            # stacks 'moe_state' on a leading layer axis (pipeline.py)
+            moe_state = stack_block_params(moe_state, model_cfg.n_layer)
     else:
         variables = model.init({"params": rng, "dropout": rng}, dummy, dummy)
         params = variables["params"]
-    moe_state = variables.get("moe_state", {})
+        moe_state = variables.get("moe_state", {})
     opt_state = tx.init(params)
     return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                       opt_state=opt_state, moe_state=moe_state)

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# Build the in-image real-text corpus (air-gapped stand-in for
+# tinyshakespeare: concatenated English docs from site-packages +
+# /usr/share/common-licenses) and tokenize it byte-level into
+# data/realtext/{train,val}.bin. Zero-egress images can't fetch the
+# reference's corpus URL (data/shakespeare/prepare.py:7-36); the
+# prepare script's --input path exists for exactly this.
+set -euo pipefail
+SP=$(python -c "import site; print(site.getsitepackages()[0])")
+OUT=${1:-data/realtext}
+TMP=$(mktemp)
+{ find "$SP" \( -name "*.md" -o -name "*.rst" -o -name "METADATA" \) -print0 2>/dev/null | sort -z | xargs -0 cat 2>/dev/null
+  cat /usr/share/common-licenses/* 2>/dev/null; } | tr -d '\r' > "$TMP"
+python -m distributed_pytorch_tpu.data.prepare_shakespeare \
+    --input "$TMP" --tokenizer byte --out_dir "$OUT"
+rm -f "$TMP"

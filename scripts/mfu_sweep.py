@@ -58,6 +58,16 @@ def time_variant(batch: int, attn_impl: str, act_recomp: bool,
         print(f"batch={batch:3d} attn={attn_impl:6s} remat={act_recomp!s:5s} "
               f"loss={loss_impl:9s} FAILED: {type(e).__name__}: "
               f"{str(e)[:120]}", flush=True)
+        # Deterministic failures (OOM, VMEM-exceeded Mosaic compiles) get a
+        # distinct exit code so the parent doesn't burn a retry on a variant
+        # that can never succeed — retries are for transient tunnel HTTP 500s.
+        # bare RESOURCE_EXHAUSTED is NOT in this list: gRPC uses it for
+        # transient tunnel quota/backpressure too — device OOM always says
+        # "Out of memory" in its message
+        msg = str(e)
+        if any(s in msg for s in ("Out of memory", "VMEM", "vmem",
+                                  "exceeds available")):
+            sys.exit(3)
         return None
 
     dt = float(np.median(times))
@@ -131,6 +141,17 @@ def main():
             (16, "pallas", False, "fused", {"FLASH_BLOCK_Q": "256",
                                             "FLASH_BLOCK_K": "512",
                                             "FLASH_BLOCK_H": "24"}),
+            # slab kernel layout (round 5): zero HBM transposes — A/B vs
+            # the rows layout at the same tiles
+            (16, "pallas", False, "fused", {"FLASH_LAYOUT": "slab",
+                                            "FLASH_BLOCK_Q": "256",
+                                            "FLASH_BLOCK_K": "512"}),
+            (16, "pallas", False, "fused", {"FLASH_LAYOUT": "slab",
+                                            "FLASH_BLOCK_Q": "512",
+                                            "FLASH_BLOCK_K": "512"}),
+            (16, "pallas", False, "fused", {"FLASH_LAYOUT": "slab",
+                                            "FLASH_BLOCK_Q": "256",
+                                            "FLASH_BLOCK_K": "256"}),
             # streaming pallas CE (ops/fused_ce.py) vs the chunked scan
             (16, "xla", False, "pallas"),
             (16, "xla", False, "pallas", {"CE_BLOCK_N": "1024"}),
@@ -158,10 +179,11 @@ def main():
         tag = ",".join(f"{k}={v}" for k, v in extra_env.items())
         if tag:
             print(f"[{tag}]", flush=True)
-        # retry once on rc!=0: the tunnel's remote-compile service throws
-        # transient HTTP 500s (observed on 4/8 variants in one pass). A
-        # TIMEOUT is never retried — a wedged tunnel hangs identically on
-        # attempt 2 and would double a dead sweep's wall-clock.
+        # retry once on generic rc!=0: the tunnel's remote-compile service
+        # throws transient HTTP 500s (observed on 4/8 variants in one pass).
+        # rc=3 (deterministic OOM/VMEM failure, see time_variant) and
+        # TIMEOUT are never retried — they fail identically on attempt 2
+        # and would double a dead variant's wall-clock.
         for attempt in (1, 2):
             try:
                 r = subprocess.run(cmd, timeout=1200, env=env)
@@ -169,6 +191,8 @@ def main():
                     break
                 print(f"variant {batch},{attn},{remat},{loss}: "
                       f"rc={r.returncode} (attempt {attempt})", flush=True)
+                if r.returncode == 3:
+                    break  # deterministic OOM/VMEM: retrying can't help
             except subprocess.TimeoutExpired:
                 print(f"variant {batch},{attn},{remat},{loss}: TIMEOUT "
                       f"(no retry)", flush=True)

@@ -90,8 +90,8 @@ def test_validation_failures():
         LLMConfig(loss_chunk=100)          # must divide block_size
     with pytest.raises(AssertionError):
         LLMConfig(n_layer=6, pp_stages=4)  # must divide n_layer
-    with pytest.raises(AssertionError):
-        LLMConfig(moe=True, pp_stages=2, n_layer=4)  # pp x moe unsupported
+    # pp x moe is SUPPORTED since round 5 (models/pipeline.py)
+    assert LLMConfig(moe=True, pp_stages=2, n_layer=4).moe
     with pytest.raises(AssertionError):
         TrainConfig(parallelism="5d")
 

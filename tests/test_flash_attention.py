@@ -263,3 +263,213 @@ def test_row_group_defaults_to_one_for_gqa():
     with pytest.raises(AssertionError):
         flash_attention(q, k, v, scale=0.18, block_q=32, block_k=32,
                         block_h=4, interpret=True)
+
+
+class TestDropout:
+    """In-kernel attention-weight dropout (round 5; reference
+    model.py:149-151 SDPA dropout). The mask is regenerated from the tile
+    coordinates in forward and both backward kernels, so the strongest
+    check is jax.test_util.check_grads: finite differences validate the
+    custom VJP against the (deterministic, seeded) forward itself."""
+
+    def test_rate_zero_identical(self):
+        q, k, v = rand_qkv(jax.random.PRNGKey(3), 2, 64, 64, 4, 4, 32)
+        base = flash_attention(q, k, v, scale=0.18, block_q=32, block_k=32,
+                               interpret=True)
+        zero = flash_attention(q, k, v, scale=0.18, block_q=32, block_k=32,
+                               dropout_rate=0.0, interpret=True)
+        np.testing.assert_array_equal(np.asarray(base), np.asarray(zero))
+
+    def test_dropout_changes_output_and_is_seed_deterministic(self):
+        q, k, v = rand_qkv(jax.random.PRNGKey(4), 2, 64, 64, 4, 4, 32)
+        rng = jax.random.PRNGKey(7)
+        f = functools.partial(flash_attention, scale=0.18, block_q=32,
+                              block_k=32, interpret=True, dropout_rate=0.3)
+        a = f(q, k, v, dropout_rng=rng)
+        b = f(q, k, v, dropout_rng=rng)
+        c = f(q, k, v, dropout_rng=jax.random.PRNGKey(8))
+        base = flash_attention(q, k, v, scale=0.18, block_q=32, block_k=32,
+                               interpret=True)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert not np.allclose(np.asarray(a), np.asarray(c))
+        assert not np.allclose(np.asarray(a), np.asarray(base))
+
+    def test_dropout_mean_preserving(self):
+        """Inverted dropout: E[out] == undropped out. Mean over many seeds
+        of a single attention row should approach the base output."""
+        q, k, v = rand_qkv(jax.random.PRNGKey(5), 1, 32, 32, 2, 2, 32)
+        base = flash_attention(q, k, v, scale=0.18, block_q=32, block_k=32,
+                               interpret=True)
+        outs = [flash_attention(q, k, v, scale=0.18, block_q=32, block_k=32,
+                                dropout_rate=0.25,
+                                dropout_rng=jax.random.PRNGKey(100 + s),
+                                interpret=True)
+                for s in range(48)]
+        mean = np.mean([np.asarray(o) for o in outs], axis=0)
+        # noisy statistic: elementwise tolerance is loose, the bias check
+        # is the mean-over-everything one
+        np.testing.assert_allclose(mean.mean(), np.asarray(base).mean(),
+                                   atol=0.05)
+        assert np.abs(mean - np.asarray(base)).mean() < 0.15
+
+    @pytest.mark.parametrize("nh,nkv", [(4, 4), (4, 2)])
+    def test_dropout_grads_vs_finite_differences(self, nh, nkv):
+        from jax.test_util import check_grads
+        q, k, v = rand_qkv(jax.random.PRNGKey(6), 1, 32, 32, nh, nkv, 32)
+        rng = jax.random.PRNGKey(11)
+
+        def f(q, k, v):
+            return flash_attention(q, k, v, scale=0.18, block_q=16,
+                                   block_k=16, dropout_rate=0.2,
+                                   dropout_rng=rng, interpret=True)
+
+        check_grads(f, (q, k, v), order=1, modes=["rev"], atol=2e-2,
+                    rtol=2e-2)
+
+    def test_dispatcher_routes_dropout_to_naive_off_tpu(self):
+        """Off-TPU the dispatcher must keep the naive dropout path (the
+        flash route is TPU-gated)."""
+        from distributed_pytorch_tpu.ops.attention_core import sdpa
+        q, k, v = rand_qkv(jax.random.PRNGKey(12), 2, 32, 32, 4, 4, 32)
+        out = sdpa(q, k, v, dropout_rate=0.5,
+                   dropout_rng=jax.random.PRNGKey(0), impl="auto")
+        assert np.isfinite(np.asarray(out)).all()
+
+    @pytest.mark.parametrize("nh,nkv", [(2, 2), (4, 2)])
+    def test_dropout_exact_vs_replayed_mask_oracle(self, nh, nkv):
+        """The hash mask is keyed on absolute positions, so the test can
+        replay it on the host and feed an explicit-mask einsum oracle:
+        flash-with-dropout must match EXACTLY (not just statistically)."""
+        from distributed_pytorch_tpu.ops.flash_attention import _dropout_bits
+        B, T, hs, rate = 2, 64, 32, 0.3
+        q, k, v = rand_qkv(jax.random.PRNGKey(13), B, T, T, nh, nkv, hs)
+        scale = 1.0 / hs ** 0.5
+        rng = jax.random.PRNGKey(21)
+        out = flash_attention(q, k, v, scale=scale, block_q=32, block_k=16,
+                              dropout_rate=rate, dropout_rng=rng,
+                              interpret=True)
+
+        seed = jax.random.randint(rng, (2,), -2 ** 31, 2 ** 31 - 1,
+                                  jnp.int32)
+        bits = _dropout_bits(seed[0], seed[1], 0, 0, 0, (B * nh, T, T))
+        thresh = np.uint32(min(int(rate * 2.0 ** 32), 2 ** 32 - 1))
+        keep = (np.asarray(bits) >= thresh).astype(np.float32) / (1 - rate)
+        keep = keep.reshape(B, nh, T, T)
+
+        kk = np.repeat(np.asarray(k), nh // nkv, axis=2)
+        vv = np.repeat(np.asarray(v), nh // nkv, axis=2)
+        s = np.einsum("btnh,bsnh->bnts", np.asarray(q, np.float32),
+                      kk.astype(np.float32)) * scale
+        mask = np.tril(np.ones((T, T), bool))
+        s = np.where(mask[None, None], s, -np.inf)
+        attn = np.exp(s - s.max(-1, keepdims=True))
+        attn /= attn.sum(-1, keepdims=True)
+        ref = np.einsum("bnts,bsnh->btnh", attn * keep, vv)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5,
+                                   atol=2e-5)
+
+
+class TestSlabLayout:
+    """'slab' kernel layout (round 5): reads (B, T, N*H) slabs directly —
+    no HBM transposes — with in-VMEM head-major relayout, in-kernel GQA
+    expansion, and write-step dk/dv group-sum. Must be numerically
+    identical in semantics to the rows layout and the naive oracle.
+    Head-slab widths are chosen lane-aligned ((n*hs) % 128 == 0)."""
+
+    CASES = [(4, 4, 32), (4, 2, 64), (8, 1, 16)]  # (nh, nkv, hs)
+
+    @pytest.mark.parametrize("nh,nkv,hs", CASES)
+    def test_forward_matches_naive(self, nh, nkv, hs):
+        q, k, v = rand_qkv(jax.random.PRNGKey(0), 2, 128, 128, nh, nkv, hs)
+        scale = 1.0 / hs ** 0.5
+        out = flash_attention(q, k, v, scale=scale, block_q=64, block_k=32,
+                              layout="slab", interpret=True)
+        ref = _naive_sdpa(q, k, v, scale=scale, q_offset=0, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    @pytest.mark.parametrize("nh,nkv,hs", CASES)
+    def test_grads_match_naive(self, nh, nkv, hs):
+        q, k, v = rand_qkv(jax.random.PRNGKey(1), 2, 128, 128, nh, nkv, hs)
+        scale = 1.0 / hs ** 0.5
+        w = jax.random.normal(jax.random.PRNGKey(2), q.shape)
+
+        def f(q, k, v):
+            return jnp.sum(flash_attention(
+                q, k, v, scale=scale, block_q=64, block_k=32,
+                layout="slab", interpret=True) * w)
+
+        def n(q, k, v):
+            return jnp.sum(_naive_sdpa(q, k, v, scale=scale, q_offset=0,
+                                       causal=True) * w)
+
+        gf = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        gn = jax.grad(n, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gn):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_prefill_longer_cache(self):
+        """S > T (prefill into a longer zero-padded cache): positional
+        causal mask must hide the tail, as in the rows layout."""
+        q, k, v = rand_qkv(jax.random.PRNGKey(3), 2, 64, 256, 4, 4, 32)
+        out = flash_attention(q, k, v, scale=0.18, block_q=32, block_k=32,
+                              layout="slab", interpret=True)
+        ref = _naive_sdpa(q, k, v, scale=0.18, q_offset=0, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_noncausal(self):
+        q, k, v = rand_qkv(jax.random.PRNGKey(4), 2, 64, 64, 4, 2, 32)
+        out = flash_attention(q, k, v, scale=0.18, block_q=32, block_k=32,
+                              layout="slab", causal=False, interpret=True)
+        ref = _naive_sdpa(q, k, v, scale=0.18, q_offset=0, causal=False)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_dropout_identical_masks_across_layouts(self):
+        """The dropout hash is keyed on absolute positions, so rows and
+        slab layouts must produce bit-identical dropped outputs."""
+        q, k, v = rand_qkv(jax.random.PRNGKey(5), 2, 64, 64, 4, 4, 32)
+        rng = jax.random.PRNGKey(9)
+        a = flash_attention(q, k, v, scale=0.18, block_q=32, block_k=32,
+                            layout="rows", dropout_rate=0.3,
+                            dropout_rng=rng, interpret=True)
+        b = flash_attention(q, k, v, scale=0.18, block_q=16, block_k=64,
+                            layout="slab", dropout_rate=0.3,
+                            dropout_rng=rng, interpret=True)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_lse_and_dlse_match_rows(self):
+        """The differentiable-lse contract (ring merge) must hold for the
+        slab path too: same lse values, same d/dlse folding."""
+        from distributed_pytorch_tpu.ops.flash_attention import (
+            flash_attention_lse)
+        q, k, v = rand_qkv(jax.random.PRNGKey(6), 2, 64, 64, 4, 4, 32)
+        wl = jax.random.normal(jax.random.PRNGKey(7), (2, 64, 4))
+        wo = jax.random.normal(jax.random.PRNGKey(8), q.shape)
+
+        def loss(layout):
+            def f(q, k, v):
+                out, lse = flash_attention_lse(
+                    q, k, v, scale=0.18, block_q=32, block_k=32,
+                    layout=layout, interpret=True)
+                return jnp.sum(out * wo) + jnp.sum(lse * wl)
+            return f
+
+        (la, ga) = jax.value_and_grad(loss("rows"), argnums=(0, 1, 2))(
+            q, k, v)
+        (lb, gb) = jax.value_and_grad(loss("slab"), argnums=(0, 1, 2))(
+            q, k, v)
+        np.testing.assert_allclose(float(la), float(lb), rtol=1e-5)
+        for a, b in zip(ga, gb):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_usable_gate_slab(self):
+        from distributed_pytorch_tpu.ops.flash_attention import (
+            slab_attention_usable)
+        assert slab_attention_usable(2, 1024, 1024, 12, 12, 64, jnp.bfloat16)
+        assert not slab_attention_usable(2, 1024, 1024, 3, 3, 24,
+                                         jnp.bfloat16)  # 72 lanes

@@ -183,6 +183,65 @@ def test_pallas_ce_dp_shard_map_parity():
                                    rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.parametrize("chunk", [0, 8])
+def test_sp_fused_ce_matches_oracle(chunk):
+    """Sequence-parallel chunked CE (round-5: replaces the unchunked
+    fallback under a live 'seq' axis): value and grads must match the
+    full-logits oracle on a data=4 x seq=2 mesh, with and without an
+    explicit chunk size, including masked targets."""
+    from distributed_pytorch_tpu.ops.losses import sp_fused_cross_entropy
+    from distributed_pytorch_tpu.parallel import context
+    from distributed_pytorch_tpu.parallel.mesh import mesh_for
+
+    x, emb, tgt = _data(B=8, T=32, C=16, V=64, seed=3)
+    tgt = tgt.at[:, 28:].set(-1)
+    ref, g_ref = jax.value_and_grad(
+        lambda a, e: unchunked_cross_entropy(a, e, tgt), argnums=(0, 1))(
+        x, emb)
+    mesh = mesh_for("sp", sp_size=2)
+    with context.use_mesh(mesh):
+        got, g_got = jax.value_and_grad(
+            lambda a, e: sp_fused_cross_entropy(a, e, tgt, chunk=chunk),
+            argnums=(0, 1))(x, emb)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-6)
+    for r, g in zip(g_ref, g_got):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_sp_train_step_uses_chunked_loss():
+    """End-to-end: an sp-recipe train step at fused loss_impl must agree
+    with the single-device oracle (this now routes through
+    sp_fused_cross_entropy at trace time)."""
+    from distributed_pytorch_tpu.config import TrainConfig
+    from distributed_pytorch_tpu.parallel import context
+    from distributed_pytorch_tpu.parallel.mesh import mesh_for
+    from distributed_pytorch_tpu.train.state import create_train_state
+    from distributed_pytorch_tpu.train.step import make_train_step
+
+    mc = LLMConfig(vocab_size=128, block_size=32, n_embd=32, n_head=4,
+                   n_kv_heads=4, n_layer=2, up_dim=64, loss_impl="fused",
+                   loss_chunk=8)
+    x = jax.random.randint(jax.random.PRNGKey(1), (1, 8, 32), 0, 128)
+    y = jax.random.randint(jax.random.PRNGKey(2), (1, 8, 32), 0, 128)
+
+    tc1 = TrainConfig(total_batch_size=8 * 32, batch_size=8, max_iters=2,
+                      parallelism="single")
+    model, tx, state, _ = create_train_state(mc, tc1, None)
+    step = make_train_step(model, tx, mc, tc1, None, None)
+    _, m_ref = step(state, x, y)
+
+    tc2 = TrainConfig(total_batch_size=8 * 32, batch_size=8, max_iters=2,
+                      parallelism="sp", sp_size=2)
+    mesh = mesh_for("sp", sp_size=2)
+    with context.use_mesh(mesh):
+        model2, tx2, state2, sh2 = create_train_state(mc, tc2, mesh)
+        step2 = make_train_step(model2, tx2, mc, tc2, mesh, sh2)
+        _, m_sp = step2(state2, x, y)
+    np.testing.assert_allclose(float(m_sp["loss"]), float(m_ref["loss"]),
+                               rtol=2e-5)
+
+
 def test_pallas_ce_real_vocab_padding():
     """GPT-2 vocab 50304 pads to 51200 (25 x 2048 tiles): the production
     padding path with the last tile 1152-valid, tiny N/C to keep

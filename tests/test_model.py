@@ -124,6 +124,53 @@ def test_act_recomp_matches_plain(policy):
     del chex_close
 
 
+@pytest.mark.parametrize("policy", ["block", "attn"])
+def test_act_recomp_moe_matches_plain(policy):
+    """Remat x MoE — the exact combination the reference documents as
+    erroring ("scary looking error when we add MoE in checkpoint",
+    kaggle-ddp.py:526-534): a Block wrapped in nn.remat carries the mutable
+    'moe_state' collection. Loss and grads must match the plain MoE model,
+    and the aux-free bias update must still fire under remat."""
+    kw = dict(moe=True, n_exp=4, n_shared=1, n_act=2, aux_free=True,
+              alpha=1e-4, gamma=0.1, coeff=0.01)
+    cfg = tiny_config(**kw)
+    cfg_r = tiny_config(act_recomp=True, act_recomp_policy=policy, **kw)
+    model, model_r = LLM(cfg), LLM(cfg_r)
+    idx = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, VOCAB)
+    tgt = jax.random.randint(jax.random.PRNGKey(2), (2, 16), 0, VOCAB)
+    variables = model.init(jax.random.PRNGKey(0), idx, tgt)
+
+    (_, loss, _), _ = model.apply(variables, idx, tgt, mutable=["moe_state"])
+    (_, loss_r, _), _ = model_r.apply(variables, idx, tgt,
+                                      mutable=["moe_state"])
+    assert jnp.allclose(loss, loss_r, atol=1e-5)
+
+    def lf(m):
+        def f(p):
+            (_, l, _), _ = m.apply(
+                {"params": p, "moe_state": variables["moe_state"]},
+                idx, tgt, mutable=["moe_state"])
+            return l
+        return f
+
+    g = jax.grad(lf(model))(variables["params"])
+    g_r = jax.grad(lf(model_r))(variables["params"])
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, atol=1e-4), g, g_r)
+
+    # training-mode apply (deterministic=False): the bias update mutates
+    # moe_state INSIDE the remat region — the landmine case itself
+    (_, loss_t, _), upd = model_r.apply(variables, idx, tgt,
+                                        deterministic=False,
+                                        mutable=["moe_state"])
+    assert jnp.isfinite(loss_t)
+    b0 = jax.tree_util.tree_leaves(variables["moe_state"])
+    b1 = jax.tree_util.tree_leaves(upd["moe_state"])
+    assert any(not np.allclose(np.asarray(a), np.asarray(b))
+               for a, b in zip(b0, b1)), \
+        "aux-free bias did not update under remat"
+
+
 def test_count_params_dense_equals_total():
     cfg = tiny_config()
     variables, _, _ = init_and_forward(cfg)

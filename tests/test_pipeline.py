@@ -141,6 +141,105 @@ def test_pp_checkpoint_unstacks_for_sampling(tmp_path, monkeypatch):
     np.testing.assert_allclose(float(l_loop), float(l_pp), rtol=1e-5)
 
 
+MOE_KW = dict(moe=True, n_exp=4, n_shared=1, n_act=2, alpha=1e-2,
+              gamma=0.1, coeff=0.01)
+
+
+def _moe_models(pp_microbatches, **extra):
+    kw = {**KW, **MOE_KW, **extra}
+    loop_cfg = LLMConfig(**kw)
+    pp_cfg = LLMConfig(**kw, pp_stages=2, pp_microbatches=pp_microbatches)
+    idx = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 96)
+    tgt = jax.random.randint(jax.random.PRNGKey(2), (8, 16), 0, 96)
+    loop_model, pp_model = LLM(loop_cfg), LLM(pp_cfg)
+    variables = loop_model.init(jax.random.PRNGKey(0), idx, tgt)
+    pp_vars = {"params": stack_block_params(variables["params"],
+                                            KW["n_layer"])}
+    if "moe_state" in variables:  # aux_free only
+        pp_vars["moe_state"] = stack_block_params(variables["moe_state"],
+                                                  KW["n_layer"])
+    return loop_model, pp_model, variables, pp_vars, idx, tgt
+
+
+@pytest.mark.parametrize("aux_free", [True, False])
+def test_pp_moe_matches_loop_single_microbatch(aux_free):
+    """MoE x pp at M=1: one microbatch IS the full batch, so loss (incl.
+    the aux term) must be bit-comparable to the loop model — this also
+    proves bubble-slot masking, since at M=1 all but one slot per tick is
+    a bubble whose zero-token routing would otherwise contribute aux."""
+    loop_model, pp_model, variables, pp_vars, idx, tgt = \
+        _moe_models(1, aux_free=aux_free)
+    (_, loss_loop, _), _ = loop_model.apply(variables, idx, tgt,
+                                            mutable=["moe_state"])
+    (_, loss_pp, _), _ = pp_model.apply(pp_vars, idx, tgt,
+                                        mutable=["moe_state"])
+    np.testing.assert_allclose(float(loss_pp), float(loss_loop), rtol=1e-6)
+
+
+def test_pp_moe_main_loss_microbatch_invariant():
+    """With the aux coefficient zeroed, the MoE pp loss must equal the loop
+    model at any M (token outputs are exact; only the aux statistics are
+    per-microbatch, documented in run_pipeline)."""
+    loop_model, pp_model, variables, pp_vars, idx, tgt = \
+        _moe_models(4, alpha=0.0, aux_free=True)
+    (_, loss_loop, _), _ = loop_model.apply(variables, idx, tgt,
+                                            mutable=["moe_state"])
+    (_, loss_pp, _), _ = pp_model.apply(pp_vars, idx, tgt,
+                                        mutable=["moe_state"])
+    np.testing.assert_allclose(float(loss_pp), float(loss_loop), rtol=1e-6)
+
+
+def test_pp_moe_bias_update_matches_loop_m1():
+    """Training-mode apply at M=1: the aux-free bias update must be exactly
+    the loop model's (same fi over the full batch, one gamma step per
+    layer) — any bubble-slot pollution or scan-carry mistake shows here."""
+    loop_model, pp_model, variables, pp_vars, idx, tgt = _moe_models(1)
+    rngs = {"dropout": jax.random.PRNGKey(3)}
+    _, upd_loop = loop_model.apply(variables, idx, tgt,
+                                   deterministic=False,
+                                   mutable=["moe_state"], rngs=rngs)
+    _, upd_pp = pp_model.apply(pp_vars, idx, tgt, deterministic=False,
+                               mutable=["moe_state"], rngs=rngs)
+    pp_unstacked = unstack_block_params(upd_pp["moe_state"], KW["n_layer"])
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a),
+                                                np.asarray(b), atol=1e-6),
+        upd_loop["moe_state"], pp_unstacked)
+    # and the update must actually move
+    moved = jax.tree_util.tree_map(
+        lambda a, b: not np.allclose(np.asarray(a), np.asarray(b)),
+        variables["moe_state"], upd_loop["moe_state"])
+    assert any(jax.tree_util.tree_leaves(moved))
+
+
+def test_pp_moe_train_step_runs():
+    """One jitted train step with MoE x pp on the 8-device mesh (pipe=2 x
+    data=4): finite loss, bias moves."""
+    from distributed_pytorch_tpu.config import TrainConfig
+    from distributed_pytorch_tpu.parallel.mesh import build_mesh, resolve_plan
+    from distributed_pytorch_tpu.train.state import create_train_state
+    from distributed_pytorch_tpu.train.step import make_train_step
+    from distributed_pytorch_tpu.parallel import context
+
+    mc = LLMConfig(**{**KW, **MOE_KW}, pp_stages=2, pp_microbatches=2)
+    tc = TrainConfig(total_batch_size=8 * 32, batch_size=8, max_iters=2,
+                     parallelism="pp", pp_size=2)
+    mesh = build_mesh(resolve_plan("pp", 8, pp_size=2))
+    with context.use_mesh(mesh):
+        model, tx, state, state_sh = create_train_state(mc, tc, mesh)
+        step = make_train_step(model, tx, mc, tc, mesh, state_sh)
+        bias0 = [np.asarray(b) for b in
+                 jax.tree_util.tree_leaves(state.moe_state)]
+        assert bias0 and bias0[0].shape[0] == KW["n_layer"]  # layer-stacked
+        x = jax.random.randint(jax.random.PRNGKey(7), (1, 8, 32), 0, 96)
+        y = jax.random.randint(jax.random.PRNGKey(8), (1, 8, 32), 0, 96)
+        state, m = step(state, x, y)
+        assert np.isfinite(float(m["loss"]))
+        bias1 = jax.tree_util.tree_leaves(state.moe_state)
+        assert any(not np.allclose(np.asarray(a), np.asarray(b))
+                   for a, b in zip(bias0, bias1))
+
+
 @pytest.mark.parametrize("policy", ["block", "attn"])
 def test_pp_act_recomp_matches_plain(policy):
     """Remat under pp is a pure memory/FLOPs trade: same loss as plain pp

@@ -156,6 +156,29 @@ def test_moe_state_updates_during_training():
     assert moved, "expert bias did not update"
 
 
+@pytest.mark.parametrize("policy", ["block", "attn"])
+def test_moe_train_step_under_act_recomp(policy):
+    """Full train step with remat x MoE (reference kaggle-ddp.py:526-534
+    hit an error in exactly this combination): one jitted step must run,
+    produce a finite loss, and still update the aux-free bias."""
+    mc = LLMConfig(**TINY, moe=True, n_exp=4, n_shared=1, n_act=2,
+                   aux_free=True, gamma=0.1, act_recomp=True,
+                   act_recomp_policy=policy)
+    tc = TrainConfig(total_batch_size=2 * 32, batch_size=2, max_iters=10,
+                     parallelism="single")
+    model, tx, state, _ = create_train_state(mc, tc, None)
+    step = make_train_step(model, tx, mc, tc, None, None)
+    bias0 = [np.asarray(b) for b in
+             jax.tree_util.tree_leaves(state.moe_state)]
+    x, y = _fake_batch(mc, 1, 2, seed=1)
+    state, m = step(state, x, y)
+    assert np.isfinite(float(m["loss"]))
+    bias1 = jax.tree_util.tree_leaves(state.moe_state)
+    assert any(not np.allclose(np.asarray(a), np.asarray(b))
+               for a, b in zip(bias0, bias1)), \
+        "expert bias did not update under act_recomp"
+
+
 @pytest.mark.parametrize("opt,lr", [("lion", 1e-3), ("adafactor", 3e-2)])
 def test_alternative_optimizers_learn(opt, lr, tmp_path, monkeypatch):
     """Lion / Adafactor (exceeding the reference's AdamW-only surface,

@@ -230,7 +230,10 @@ def main() -> None:
                                {"BENCH_BATCH": "32", "BENCH_REMAT": "1",
                                 "BENCH_ATTN": "pallas"}),
                               ("batch16", None)):
-                r = _spawn_worker("tpu", timeout_s=1500, extra_env=env)
+                # 900s/leg: a healthy leg is ~3 min incl. compile; the cap
+                # exists so a half-up tunnel can't eat the whole bench
+                # budget across four legs
+                r = _spawn_worker("tpu", timeout_s=900, extra_env=env)
                 if r:
                     r["config"] = name
                     candidates.append(r)

@@ -25,7 +25,6 @@ the reference's transpose dance to (B, nh, T, hs).
 from __future__ import annotations
 
 import functools
-import warnings
 from typing import Optional
 
 import jax
@@ -37,6 +36,46 @@ def _on_tpu() -> bool:
         return jax.default_backend() == "tpu"
     except Exception:  # pragma: no cover
         return False
+
+
+def _shard_map_over_data(fn, q, has_rng: bool = False):
+    """Batch-parallel shard_map wrapper for a pallas call under a live
+    multi-device mesh: GSPMD cannot partition a pallas_call (it would
+    replicate the compute after all-gathering the operands), so on dp/fsdp
+    meshes the kernel runs per data shard with explicitly local batches.
+    Returns None when no wrap is needed (single device) or when the gates
+    don't hold (head-sharded tp activations, pipeline vmap bodies, batch
+    not divisible) — those paths keep the unwrapped call/XLA fallback."""
+    from distributed_pytorch_tpu.parallel import context
+    mesh = context.get_mesh()
+    if mesh is None or context.in_sp_region():
+        return None
+    dp = mesh.shape.get("data", 1)
+    if (dp <= 1 or mesh.shape.get("model", 1) > 1
+            or mesh.shape.get("pipe", 1) > 1
+            or q.shape[0] % dp != 0 or q.shape[0] // dp < 1):
+        return None
+    from jax.sharding import PartitionSpec as P
+    spec = P("data", None, None, None)
+
+    if has_rng:
+        def body(a, b, c, rng):
+            with context.sp_region():   # suppress nested sp/wrap routing
+                # per-data-shard masks: each shard holds different samples
+                # at the same local batch rows
+                rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
+                return fn(a, b, c, rng)
+
+        return jax.shard_map(body, mesh=mesh,
+                             in_specs=(spec, spec, spec, P()),
+                             out_specs=spec)
+
+    def body(a, b, c):
+        with context.sp_region():
+            return fn(a, b, c)
+
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec)
 
 
 def _naive_sdpa(q, k, v, *, scale, q_offset, dropout_rate=0.0,
@@ -109,57 +148,53 @@ def sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     sp = context.seq_axis_size()
     sp_live = sp > 1 and not context.in_sp_region()
 
-    if use_dropout and sp_live:
-        warnings.warn(
-            "attention dropout > 0 disables the sequence-parallel "
-            "ring/Ulysses path: every device falls back to full-sequence "
-            "O(T^2) attention, defeating the sp recipe's memory purpose. "
-            "Set dropout=0.0 (the default) for sp training.",
-            RuntimeWarning, stacklevel=2)
-
-    if not use_dropout:
-        if sp_live and impl in ("auto", "ring", "zigzag", "ulysses"):
-            static_zero = isinstance(q_offset, int) and q_offset == 0
-            mesh = context.get_mesh()
-            dp = mesh.shape["data"]
-            T, S, B = q.shape[1], k.shape[1], q.shape[0]
-            sp_ok = (causal and static_zero and T == S and T % sp == 0
-                     and B % dp == 0 and T // sp > 0)
-            if sp_ok:
-                from distributed_pytorch_tpu.ops.ring_attention import sp_sdpa
-                if impl == "ulysses":
-                    sp_impl = "ulysses"
-                elif impl == "ring":
-                    sp_impl = "ring"      # explicit: contiguous schedule
-                else:                     # 'auto'/'zigzag': load-balanced
-                    sp_impl = "zigzag"    # (falls back to ring inside when
-                                          # the stripe split doesn't divide)
-                if (sp_impl == "ulysses"
-                        and (q.shape[2] % sp or k.shape[2] % sp)):
-                    sp_impl = "zigzag"  # head counts not sp-divisible
-                return sp_sdpa(q, k, v, scale=scale, causal=causal,
-                               impl=sp_impl)
-        if impl in ("ring", "zigzag", "ulysses"):
-            # De-trap (round-3 VERDICT #9): an explicit ring/ulysses request
-            # on training-like shapes (full causal self-attention) with NO
-            # live 'seq' axis means the caller traced without
-            # context.use_mesh — the old silent GSPMD-full-gather fallback
-            # hid exactly the bug the ambient-mesh design risks. Fail loud.
-            # Decode-shaped calls (T != S, cache offsets) legitimately fall
-            # back: decoding isn't sequence-parallel even in sp training.
-            training_like = (causal and not decode
-                             and q.shape[1] == k.shape[1]
-                             and q.shape[1] > 1
-                             and isinstance(q_offset, int) and q_offset == 0)
-            if training_like and sp <= 1 and not context.in_sp_region():
-                raise ValueError(
-                    f"attn_impl={impl!r} requested but no live 'seq' mesh "
-                    "axis is visible at trace time. Establish the mesh "
-                    "around tracing (parallel.context.use_mesh, as the "
-                    "trainer's step builders do) or use the 'sp' recipe; "
-                    "a silent fallback here would lose sequence "
-                    "parallelism without any signal.")
-            impl = "auto"  # shapes don't allow sp (e.g. decode steps)
+    if sp_live and impl in ("auto", "ring", "zigzag", "ulysses"):
+        static_zero = isinstance(q_offset, int) and q_offset == 0
+        mesh = context.get_mesh()
+        dp = mesh.shape["data"]
+        T, S, B = q.shape[1], k.shape[1], q.shape[0]
+        sp_ok = (causal and static_zero and T == S and T % sp == 0
+                 and B % dp == 0 and T // sp > 0)
+        if sp_ok:
+            from distributed_pytorch_tpu.ops.ring_attention import sp_sdpa
+            if impl == "ulysses":
+                sp_impl = "ulysses"
+            elif impl == "ring":
+                sp_impl = "ring"      # explicit: contiguous schedule
+            else:                     # 'auto'/'zigzag': load-balanced
+                sp_impl = "zigzag"    # (falls back to ring inside when
+                                      # the stripe split doesn't divide)
+            if (sp_impl == "ulysses"
+                    and (q.shape[2] % sp or k.shape[2] % sp)):
+                sp_impl = "zigzag"  # head counts not sp-divisible
+            # dropout composes with sp since round 5: the ring/zig-zag
+            # einsum hops draw a global-position-keyed mask (sp_sdpa);
+            # ulysses reroutes to zigzag inside when rate > 0
+            return sp_sdpa(q, k, v, scale=scale, causal=causal,
+                           impl=sp_impl,
+                           dropout_rate=dropout_rate if use_dropout else 0.0,
+                           dropout_rng=dropout_rng)
+    if impl in ("ring", "zigzag", "ulysses"):
+        # De-trap (round-3 VERDICT #9): an explicit ring/ulysses request
+        # on training-like shapes (full causal self-attention) with NO
+        # live 'seq' axis means the caller traced without
+        # context.use_mesh — the old silent GSPMD-full-gather fallback
+        # hid exactly the bug the ambient-mesh design risks. Fail loud.
+        # Decode-shaped calls (T != S, cache offsets) legitimately fall
+        # back: decoding isn't sequence-parallel even in sp training.
+        training_like = (causal and not decode
+                         and q.shape[1] == k.shape[1]
+                         and q.shape[1] > 1
+                         and isinstance(q_offset, int) and q_offset == 0)
+        if training_like and sp <= 1 and not context.in_sp_region():
+            raise ValueError(
+                f"attn_impl={impl!r} requested but no live 'seq' mesh "
+                "axis is visible at trace time. Establish the mesh "
+                "around tracing (parallel.context.use_mesh, as the "
+                "trainer's step builders do) or use the 'sp' recipe; "
+                "a silent fallback here would lose sequence "
+                "parallelism without any signal.")
+        impl = "auto"  # shapes don't allow sp (e.g. decode steps)
 
     if use_dropout:
         # the flash kernel applies attention-weight dropout IN-KERNEL
@@ -173,9 +208,15 @@ def sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                 flash_attention, flash_attention_usable)
             static_zero = isinstance(q_offset, int) and q_offset == 0
             if static_zero and flash_attention_usable(q, k, v, causal=causal):
-                return flash_attention(q, k, v, scale=scale, causal=causal,
-                                       dropout_rate=dropout_rate,
-                                       dropout_rng=dropout_rng)
+                def fn(a, b, c, rng):
+                    return flash_attention(a, b, c, scale=scale,
+                                           causal=causal,
+                                           dropout_rate=dropout_rate,
+                                           dropout_rng=rng)
+                wrapped = _shard_map_over_data(fn, q, has_rng=True)
+                if wrapped is not None:
+                    return wrapped(q, k, v, dropout_rng)
+                return fn(q, k, v, dropout_rng)
         impl = "naive"
     elif impl == "auto":
         # XLA's fused attention is at parity with the Pallas kernel for
@@ -188,7 +229,12 @@ def sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
         from distributed_pytorch_tpu.ops.flash_attention import flash_attention_usable, flash_attention
         static_zero = isinstance(q_offset, int) and q_offset == 0
         if static_zero and flash_attention_usable(q, k, v, causal=causal):
-            return flash_attention(q, k, v, scale=scale, causal=causal)
+            fn = functools.partial(flash_attention, scale=scale,
+                                   causal=causal)
+            wrapped = _shard_map_over_data(fn, q)
+            if wrapped is not None:
+                return wrapped(q, k, v)
+            return fn(q, k, v)
         impl = "xla"
 
     if impl == "xla":

@@ -112,21 +112,17 @@ def _bdot_t(a, b):
                                preferred_element_type=jnp.float32)
 
 
-def _dropout_bits(seed0, seed1, row0, q0, k0, shape):
-    """Counter-based uint32 hash (murmur3-finalizer style) keyed on the
-    ABSOLUTE (attention row, query position, key position) of every score
-    element plus the caller seed. Pure jnp int ops: runs identically in
-    the compiled kernel (VPU), in interpret mode (pltpu.prng_* has no CPU
-    lowering), and in plain host code (tests replay the exact mask for an
-    oracle comparison). Absolute-position keying makes the mask independent
-    of block sizes and of which kernel's grid order regenerates it."""
+def _mix_bits(seed0, seed1, row, qp, kp):
+    """Counter-based uint32 hash (murmur3-finalizer style) over already-
+    broadcast (attention row, query position, key position) uint32 arrays
+    plus the caller seed. Pure jnp int ops: runs identically in the
+    compiled kernel (VPU), in interpret mode (pltpu.prng_* has no CPU
+    lowering), in the ring-attention einsum hops, and in plain host code
+    (tests replay the exact mask for an oracle comparison)."""
     u32 = lambda a: jnp.asarray(a).astype(jnp.uint32)  # noqa: E731
-    row = u32(row0) + jax.lax.broadcasted_iota(jnp.uint32, shape, 0)
-    qp = u32(q0) + jax.lax.broadcasted_iota(jnp.uint32, shape, 1)
-    kp = u32(k0) + jax.lax.broadcasted_iota(jnp.uint32, shape, 2)
-    x = row * jnp.uint32(0x9E3779B1)
-    x = x ^ (qp * jnp.uint32(0x85EBCA6B))
-    x = x ^ (kp * jnp.uint32(0xC2B2AE35))
+    x = u32(row) * jnp.uint32(0x9E3779B1)
+    x = x ^ (u32(qp) * jnp.uint32(0x85EBCA6B))
+    x = x ^ (u32(kp) * jnp.uint32(0xC2B2AE35))
     x = x ^ u32(seed0)
     x = x + u32(seed1) * jnp.uint32(0x27D4EB2F)
     x = x ^ (x >> 16)
@@ -135,6 +131,32 @@ def _dropout_bits(seed0, seed1, row0, q0, k0, shape):
     x = x * jnp.uint32(0xC2B2AE35)
     x = x ^ (x >> 16)
     return x
+
+
+def dropout_threshold(rate: float) -> jnp.ndarray:
+    """uint32 threshold with P(bits < t) = rate."""
+    return jnp.uint32(min(int(rate * 2.0 ** 32), 2 ** 32 - 1))
+
+
+def fold_seed_for_data_shard(seed, didx):
+    """Decorrelate a (2,) int32 dropout seed across 'data' shards (each
+    shard holds different samples at the same shard-local batch rows). ONE
+    definition shared by the sp ring hops (ops/ring_attention.py) and the
+    test-side host replay, so the fold can't drift between them."""
+    return seed ^ (jnp.asarray(didx).astype(jnp.int32)
+                   * jnp.int32(0x9E3779B9 - 2 ** 32))
+
+
+def _dropout_bits(seed0, seed1, row0, q0, k0, shape):
+    """_mix_bits keyed on the ABSOLUTE coordinates of every element of a
+    (rows, q, k) tile starting at (row0, q0, k0). Absolute-position keying
+    makes the mask independent of block sizes and of which kernel's grid
+    order regenerates it."""
+    u32 = lambda a: jnp.asarray(a).astype(jnp.uint32)  # noqa: E731
+    row = u32(row0) + jax.lax.broadcasted_iota(jnp.uint32, shape, 0)
+    qp = u32(q0) + jax.lax.broadcasted_iota(jnp.uint32, shape, 1)
+    kp = u32(k0) + jax.lax.broadcasted_iota(jnp.uint32, shape, 2)
+    return _mix_bits(seed0, seed1, row, qp, kp)
 
 
 def _dropout_mask(seed_ref, r, i, j, shape, block_q: int, block_k: int,
@@ -147,8 +169,8 @@ def _dropout_mask(seed_ref, r, i, j, shape, block_q: int, block_k: int,
     g = shape[0]
     bits = _dropout_bits(seed_ref[0], seed_ref[1], r * g, i * block_q,
                          j * block_k, shape)
-    thresh = jnp.uint32(min(int(rate * 2.0 ** 32), 2 ** 32 - 1))
-    return (bits >= thresh).astype(jnp.float32) / (1.0 - rate)
+    return ((bits >= dropout_threshold(rate)).astype(jnp.float32)
+            / (1.0 - rate))
 
 
 def _sds(shape, dtype, like):
@@ -227,6 +249,79 @@ def _pick_group(n_rows: int, rep: int, preferred: int,
 
 
 # ---------------------------------------------------------------------------
+# shared tile math (ONE copy of the FlashAttention-2 numerics — the rows
+# and slab kernel faces differ only in how tiles are loaded/stored)
+# ---------------------------------------------------------------------------
+
+def _fwd_tile(q, k, v, r, i, j, seed_ref, m_ref, l_ref, acc_ref, *, scale,
+              block_q, block_k, causal, rate):
+    """Online-softmax update for one (g, bq, D)x(g, bk, D) tile pair.
+    Operands stay in input dtype (bf16 on TPU): the MXU accumulates in f32
+    via preferred_element_type — casting inputs up would force slow fp32
+    MXU passes."""
+    s = _bdot(q, k, trans_b=True) * scale               # (g, bq, bk) f32
+    if causal:
+        s = _mask_scores(s, i, j, block_q, block_k)
+    m_prev, l_prev = m_ref[:], l_ref[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    m_ref[:] = m_new
+    # normalizer accumulates the UNdropped p (torch drops the
+    # already-normalized attention weights); only the value accumulation
+    # sees the mask
+    l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    if rate > 0.0:
+        p = p * _dropout_mask(seed_ref, r, i, j, p.shape, block_q,
+                              block_k, rate)
+    acc_ref[:] = acc_ref[:] * alpha + _bdot(p.astype(v.dtype), v)
+
+
+def _fwd_finalize(m_ref, l_ref, acc_ref):
+    """(normalized out (g, bq, D) f32, lse (g, bq, 1) f32)."""
+    l_safe = jnp.maximum(l_ref[:], 1e-30)
+    return acc_ref[:] / l_safe, m_ref[:] + jnp.log(l_safe)
+
+
+def _dq_tile(q, k, v, do, lse, delta, r, i, j, seed_ref, dq_acc, *, scale,
+             block_q, block_k, causal, rate):
+    """dq accumulation for one tile: ds = p * (M/(1-r) * (dO V^T) - delta);
+    rowsum(dP*P) still equals rowsum(dO*O) = delta because O was computed
+    with the SAME mask."""
+    s = _bdot(q, k, trans_b=True) * scale
+    if causal:
+        s = _mask_scores(s, i, j, block_q, block_k)
+    p = jnp.exp(s - lse)                                # (g, bq, bk) f32
+    dp = _bdot(do, v, trans_b=True)
+    if rate > 0.0:
+        dp = dp * _dropout_mask(seed_ref, r, i, j, dp.shape, block_q,
+                                block_k, rate)
+    ds = p * (dp - delta)
+    dq_acc[:] = dq_acc[:] + _bdot(ds.astype(k.dtype), k)
+
+
+def _dkv_tile(q, k, v, do, lse, delta, r, i, j, seed_ref, dk_acc, dv_acc,
+              *, scale, block_q, block_k, causal, rate):
+    """dk/dv accumulation for one tile; the dropout mask is regenerated
+    with the same canonical (r, i, j) coords as forward/dq, NOT this
+    kernel's transposed grid order."""
+    s = _bdot(q, k, trans_b=True) * scale               # (g, bq, bk) f32
+    if causal:
+        s = _mask_scores(s, i, j, block_q, block_k)
+    p = jnp.exp(s - lse)
+    if rate > 0.0:
+        mask = _dropout_mask(seed_ref, r, i, j, p.shape, block_q, block_k,
+                             rate)
+        dv_acc[:] = dv_acc[:] + _bdot_t((p * mask).astype(do.dtype), do)
+        dp = _bdot(do, v, trans_b=True) * mask
+    else:
+        dv_acc[:] = dv_acc[:] + _bdot_t(p.astype(do.dtype), do)
+        dp = _bdot(do, v, trans_b=True)
+    ds = p * (dp - delta)
+    dk_acc[:] = dk_acc[:] + _bdot_t(ds.astype(q.dtype), q)
+
+
+# ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
@@ -244,32 +339,15 @@ def _fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
 
     @pl.when(j <= last_j)
     def _():
-        # operands stay in input dtype (bf16 on TPU): the MXU accumulates in
-        # f32 via preferred_element_type — casting inputs up would force
-        # slow fp32 MXU passes
-        q, k, v = q_ref[:], k_ref[:], v_ref[:]
-        s = _bdot(q, k, trans_b=True) * scale           # (g, bq, bk) f32
-        if causal:
-            s = _mask_scores(s, i, j, block_q, block_k)
-        m_prev, l_prev = m_ref[:], l_ref[:]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
-        m_ref[:] = m_new
-        # normalizer accumulates the UNdropped p (torch drops the
-        # already-normalized attention weights); only the value
-        # accumulation sees the mask
-        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        if rate > 0.0:
-            p = p * _dropout_mask(seed_ref, r, i, j, p.shape, block_q,
-                                  block_k, rate)
-        acc_ref[:] = acc_ref[:] * alpha + _bdot(p.astype(v.dtype), v)
+        _fwd_tile(q_ref[:], k_ref[:], v_ref[:], r, i, j, seed_ref, m_ref,
+                  l_ref, acc_ref, scale=scale, block_q=block_q,
+                  block_k=block_k, causal=causal, rate=rate)
 
     @pl.when(j == pl.num_programs(2) - 1)
     def _():
-        l_safe = jnp.maximum(l_ref[:], 1e-30)
-        o_ref[:] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[:] = m_ref[:] + jnp.log(l_safe)
+        o, lse = _fwd_finalize(m_ref, l_ref, acc_ref)
+        o_ref[:] = o.astype(o_ref.dtype)
+        lse_ref[:] = lse
 
 
 _SEED_SPEC = pl.BlockSpec(memory_space=pltpu.SMEM)
@@ -335,19 +413,9 @@ def _bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @pl.when(j <= last_j)
     def _():
-        q, k, v, do = q_ref[:], k_ref[:], v_ref[:], do_ref[:]
-        s = _bdot(q, k, trans_b=True) * scale
-        if causal:
-            s = _mask_scores(s, i, j, block_q, block_k)
-        p = jnp.exp(s - lse_ref[:])                     # (g, bq, bk) f32
-        dp = _bdot(do, v, trans_b=True)
-        if rate > 0.0:
-            # dS = P*(M/(1-r)*(dO V^T) - delta): rowsum(dP*P) still equals
-            # rowsum(dO*O) = delta because O was computed with the SAME mask
-            dp = dp * _dropout_mask(seed_ref, r, i, j, dp.shape, block_q,
-                                    block_k, rate)
-        ds = p * (dp - delta_ref[:])
-        dq_acc[:] = dq_acc[:] + _bdot(ds.astype(k.dtype), k)
+        _dq_tile(q_ref[:], k_ref[:], v_ref[:], do_ref[:], lse_ref[:],
+                 delta_ref[:], r, i, j, seed_ref, dq_acc, scale=scale,
+                 block_q=block_q, block_k=block_k, causal=causal, rate=rate)
 
     @pl.when(j == pl.num_programs(2) - 1)
     def _():
@@ -367,23 +435,10 @@ def _bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @pl.when(i >= first_i)
     def _():
-        q, k, v, do = q_ref[:], k_ref[:], v_ref[:], do_ref[:]
-        s = _bdot(q, k, trans_b=True) * scale           # (g, bq, bk) f32
-        if causal:
-            s = _mask_scores(s, i, j, block_q, block_k)
-        p = jnp.exp(s - lse_ref[:])
-        if rate > 0.0:
-            # same (r, i, j) seeding as forward/dq — canonical coords, not
-            # this kernel's transposed grid order
-            mask = _dropout_mask(seed_ref, r, i, j, p.shape, block_q,
-                                 block_k, rate)
-            dv_acc[:] = dv_acc[:] + _bdot_t((p * mask).astype(do.dtype), do)
-            dp = _bdot(do, v, trans_b=True) * mask
-        else:
-            dv_acc[:] = dv_acc[:] + _bdot_t(p.astype(do.dtype), do)
-            dp = _bdot(do, v, trans_b=True)
-        ds = p * (dp - delta_ref[:])
-        dk_acc[:] = dk_acc[:] + _bdot_t(ds.astype(q.dtype), q)
+        _dkv_tile(q_ref[:], k_ref[:], v_ref[:], do_ref[:], lse_ref[:],
+                  delta_ref[:], r, i, j, seed_ref, dk_acc, dv_acc,
+                  scale=scale, block_q=block_q, block_k=block_k,
+                  causal=causal, rate=rate)
 
     @pl.when(i == pl.num_programs(2) - 1)
     def _():
@@ -516,32 +571,20 @@ def _slab_fwd_kernel(seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
 
     @pl.when(j <= last_j)
     def _():
-        q = _load_hbd(q_ref, nh, D)
-        k = _load_hbd(k_ref, nkv, D, nh // nkv)
-        v = _load_hbd(v_ref, nkv, D, nh // nkv)
-        s = _bdot(q, k, trans_b=True) * scale           # (nh, bq, bk) f32
-        if causal:
-            s = _mask_scores(s, i, j, block_q, block_k)
-        m_prev, l_prev = m_ref[:], l_ref[:]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
-        m_ref[:] = m_new
-        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        if rate > 0.0:
-            # row0 = b*nh: same absolute-row keying as the rows layout, so
-            # the two layouts draw identical masks
-            p = p * _dropout_mask(seed_ref, b, i, j, p.shape, block_q,
-                                  block_k, rate)
-        acc_ref[:] = acc_ref[:] * alpha + _bdot(p.astype(v.dtype), v)
+        # dropout keying: tile row index b with group nh gives row0 = b*nh
+        # — the same absolute attention row as the rows layout, so the two
+        # layouts draw identical masks
+        _fwd_tile(_load_hbd(q_ref, nh, D), _load_hbd(k_ref, nkv, D, nh // nkv),
+                  _load_hbd(v_ref, nkv, D, nh // nkv), b, i, j, seed_ref,
+                  m_ref, l_ref, acc_ref, scale=scale, block_q=block_q,
+                  block_k=block_k, causal=causal, rate=rate)
 
     @pl.when(j == pl.num_programs(2) - 1)
     def _():
-        l_safe = jnp.maximum(l_ref[:], 1e-30)
-        o = acc_ref[:] / l_safe                         # (nh, bq, D)
+        o, lse = _fwd_finalize(m_ref, l_ref, acc_ref)   # (nh, bq, D)
         o_ref[0] = o.transpose(1, 0, 2).reshape(
             o.shape[1], nh * D).astype(o_ref.dtype)
-        lse_ref[0] = (m_ref[:, :, 0] + jnp.log(l_safe[:, :, 0])).T
+        lse_ref[0] = lse[:, :, 0].T
 
 
 def _slab_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
@@ -557,22 +600,11 @@ def _slab_bwd_dq_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @pl.when(j <= last_j)
     def _():
-        q = _load_hbd(q_ref, nh, D)
-        k = _load_hbd(k_ref, nkv, D, nh // nkv)
-        v = _load_hbd(v_ref, nkv, D, nh // nkv)
-        do = _load_hbd(do_ref, nh, D)
-        lse = lse_ref[0].T[:, :, None]                  # (nh, bq, 1)
-        delta = delta_ref[0].T[:, :, None]
-        s = _bdot(q, k, trans_b=True) * scale
-        if causal:
-            s = _mask_scores(s, i, j, block_q, block_k)
-        p = jnp.exp(s - lse)
-        dp = _bdot(do, v, trans_b=True)
-        if rate > 0.0:
-            dp = dp * _dropout_mask(seed_ref, b, i, j, dp.shape, block_q,
-                                    block_k, rate)
-        ds = p * (dp - delta)
-        dq_acc[:] = dq_acc[:] + _bdot(ds.astype(k.dtype), k)
+        _dq_tile(_load_hbd(q_ref, nh, D), _load_hbd(k_ref, nkv, D, nh // nkv),
+                 _load_hbd(v_ref, nkv, D, nh // nkv), _load_hbd(do_ref, nh, D),
+                 lse_ref[0].T[:, :, None], delta_ref[0].T[:, :, None],
+                 b, i, j, seed_ref, dq_acc, scale=scale, block_q=block_q,
+                 block_k=block_k, causal=causal, rate=rate)
 
     @pl.when(j == pl.num_programs(2) - 1)
     def _():
@@ -594,26 +626,12 @@ def _slab_bwd_dkv_kernel(seed_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 
     @pl.when(i >= first_i)
     def _():
-        q = _load_hbd(q_ref, nh, D)
-        k = _load_hbd(k_ref, nkv, D, rep)
-        v = _load_hbd(v_ref, nkv, D, rep)
-        do = _load_hbd(do_ref, nh, D)
-        lse = lse_ref[0].T[:, :, None]
-        delta = delta_ref[0].T[:, :, None]
-        s = _bdot(q, k, trans_b=True) * scale
-        if causal:
-            s = _mask_scores(s, i, j, block_q, block_k)
-        p = jnp.exp(s - lse)
-        if rate > 0.0:
-            mask = _dropout_mask(seed_ref, b, i, j, p.shape, block_q,
-                                 block_k, rate)
-            dv_acc[:] = dv_acc[:] + _bdot_t((p * mask).astype(do.dtype), do)
-            dp = _bdot(do, v, trans_b=True) * mask
-        else:
-            dv_acc[:] = dv_acc[:] + _bdot_t(p.astype(do.dtype), do)
-            dp = _bdot(do, v, trans_b=True)
-        ds = p * (dp - delta)
-        dk_acc[:] = dk_acc[:] + _bdot_t(ds.astype(q.dtype), q)
+        _dkv_tile(_load_hbd(q_ref, nh, D), _load_hbd(k_ref, nkv, D, rep),
+                  _load_hbd(v_ref, nkv, D, rep), _load_hbd(do_ref, nh, D),
+                  lse_ref[0].T[:, :, None], delta_ref[0].T[:, :, None],
+                  b, i, j, seed_ref, dk_acc, dv_acc, scale=scale,
+                  block_q=block_q, block_k=block_k, causal=causal,
+                  rate=rate)
 
     @pl.when(i == pl.num_programs(2) - 1)
     def _():
@@ -888,9 +906,9 @@ def flash_attention_lse(q, k, v, *, scale: float, causal: bool = True,
     masked/rescaled via the TPU per-core PRNG, reseeded per score tile
     from `dropout_rng` so forward and backward regenerate identical bits
     (no mask tensor ever exists in HBM). NOTE: lse is computed from the
-    UNdropped scores (it is the true logsumexp); the ring merge therefore
-    composes with dropout only per-chunk, which is why the sp path keeps
-    dropout disabled (ops/attention_core.py).
+    UNdropped scores (it is the true logsumexp). The sp ring path applies
+    dropout in its einsum hops with GLOBAL-position keying instead
+    (ops/ring_attention.py _hop_dropout_mask); flash hops stay rate==0.
     """
     B, T, nh, hs = q.shape
     S, nkv = k.shape[1], k.shape[2]

@@ -59,11 +59,39 @@ def _local_scores(q, k, scale):
                       preferred_element_type=jnp.float32) * scale
 
 
-def _chunk_update(carry, q, k, v, qo, ko, scale, causal):
+def _hop_dropout_mask(shape, qo, ko, nh, rate, seed):
+    """Scaled keep-mask for one (B, H, Tq, Tk) chunk, keyed on GLOBAL
+    (attention row, query position, key position) via the flash kernel's
+    counter-based hash (ops/flash_attention._mix_bits): every device and
+    every hop regenerates consistent, non-overlapping bits from the same
+    seed, so across the 'seq' axis the merged mask is one coherent
+    full-sequence draw — exact-parity testable against a host replay.
+    (Across 'data' shards the seed is deliberately folded per shard by
+    sp_sdpa, so masks are NOT dp-size-invariant — row keys are
+    shard-local.)"""
+    from distributed_pytorch_tpu.ops.flash_attention import (
+        _mix_bits, dropout_threshold)
+    row = (jax.lax.broadcasted_iota(jnp.uint32, shape, 0) * jnp.uint32(nh)
+           + jax.lax.broadcasted_iota(jnp.uint32, shape, 1))
+    qp = (jnp.asarray(qo).astype(jnp.uint32)
+          + jax.lax.broadcasted_iota(jnp.uint32, shape, 2))
+    kp = (jnp.asarray(ko).astype(jnp.uint32)
+          + jax.lax.broadcasted_iota(jnp.uint32, shape, 3))
+    bits = _mix_bits(seed[0], seed[1], row, qp, kp)
+    return ((bits >= dropout_threshold(rate)).astype(jnp.float32)
+            / (1.0 - rate))
+
+
+def _chunk_update(carry, q, k, v, qo, ko, scale, causal, rate=0.0,
+                  seed=None):
     """One online-softmax accumulation of local q against one kv chunk.
 
     qo/ko: global token offsets of the q and kv chunks (traced scalars).
     carry: (acc (B,H,Tq,D) f32, m (B,H,Tq,1) f32, l (B,H,Tq,1) f32).
+    `rate` > 0 applies attention-weight dropout to the value accumulation
+    only (the normalizer keeps the undropped p — torch SDPA semantics);
+    the mask is global-position-keyed (_hop_dropout_mask) so the merged
+    result is full-sequence dropout, not per-chunk.
     """
     acc, m, l = carry
     B, Tq, nh, D = q.shape
@@ -79,9 +107,11 @@ def _chunk_update(carry, q, k, v, qo, ko, scale, causal):
     nkv = v.shape[2]
     if nkv != nh:
         v = jnp.repeat(v, nh // nkv, axis=2)
+    l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    if rate > 0.0:
+        p = p * _hop_dropout_mask(p.shape, qo, ko, nh, rate, seed)
     pv = jnp.einsum("bhqk,bkhd->bhqd", p.astype(v.dtype), v,
                     preferred_element_type=jnp.float32)
-    l = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
     acc = acc * alpha + pv
     return acc, m_new, l
 
@@ -148,16 +178,19 @@ def _flash_hop(carry, q, k, v, scale, causal_mode: bool):
 
 
 def ring_attention_local(q, k, v, *, scale: float, axis_name: str = "seq",
-                         sp: int, causal: bool = True) -> jnp.ndarray:
+                         sp: int, causal: bool = True, rate: float = 0.0,
+                         seed=None) -> jnp.ndarray:
     """Ring attention body (call inside shard_map). q/k/v: local
     (B, T/sp, H|Hkv, D) shards, contiguous sequence layout (shard i holds
-    global positions [i*Tloc, (i+1)*Tloc))."""
+    global positions [i*Tloc, (i+1)*Tloc)). `rate`/`seed`: global-keyed
+    attention-weight dropout in the einsum hops (the flash-hop path is
+    rate==0 only — its per-call mask coords aren't global)."""
     idx = jax.lax.axis_index(axis_name)
     B, Tloc, nh, D = q.shape
     qo = idx * Tloc
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
-    if causal and _flash_ring_ok(q, k, v):
+    if causal and rate == 0.0 and _flash_ring_ok(q, k, v):
         # flash-kernel hops: O(Tloc) memory per hop, VMEM softmax. The
         # diagonal is trace-time static: hop s=0 holds the device's OWN kv
         # chunk (ko == qo uniformly), every later hop is either fully
@@ -181,7 +214,8 @@ def ring_attention_local(q, k, v, *, scale: float, axis_name: str = "seq",
     acc, m, l = _init_carry(q, nh, Tloc)
 
     step_fn = jax.checkpoint(functools.partial(_chunk_update, scale=scale,
-                                               causal=causal))
+                                               causal=causal, rate=rate,
+                                               seed=seed))
 
     carry = (acc, m, l)
     for s in range(sp):
@@ -213,7 +247,8 @@ def ring_attention_local(q, k, v, *, scale: float, axis_name: str = "seq",
 
 def zigzag_ring_attention_local(q, k, v, *, scale: float,
                                 axis_name: str = "seq",
-                                sp: int) -> jnp.ndarray:
+                                sp: int, rate: float = 0.0,
+                                seed=None) -> jnp.ndarray:
     """Load-balanced ("zig-zag") causal ring attention body.
 
     The contiguous layout's flaw: device sp-1 holds the latest positions
@@ -238,7 +273,7 @@ def zigzag_ring_attention_local(q, k, v, *, scale: float,
     q_lo, q_hi = q[:, :Ts], q[:, Ts:]
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
-    use_flash = _flash_ring_ok(q_lo, k[:, :Ts], v[:, :Ts])
+    use_flash = rate == 0.0 and _flash_ring_ok(q_lo, k[:, :Ts], v[:, :Ts])
 
     if use_flash:
         # Stripe diagonals are trace-time static too: they occur ONLY at
@@ -278,7 +313,8 @@ def zigzag_ring_attention_local(q, k, v, *, scale: float,
         return jnp.concatenate([c_lo[0], c_hi[0]], axis=1).astype(q.dtype)
 
     step_fn = jax.checkpoint(functools.partial(_chunk_update,
-                                               scale=scale, causal=True))
+                                               scale=scale, causal=True,
+                                               rate=rate, seed=seed))
 
     def masked_update(carry, q_part, kv_k, kv_v, qo, ko):
         return jax.lax.cond(
@@ -349,7 +385,8 @@ def ulysses_attention_local(q, k, v, *, scale: float, axis_name: str = "seq",
 
 
 def sp_sdpa(q, k, v, *, scale: float, causal: bool = True,
-            impl: str = "ring", attn_impl: str = "auto") -> jnp.ndarray:
+            impl: str = "ring", attn_impl: str = "auto",
+            dropout_rate: float = 0.0, dropout_rng=None) -> jnp.ndarray:
     """Dispatcher entry: run ring/Ulysses attention over the ambient mesh's
     'seq' axis via shard_map. q (B,T,nh,hs), k/v (B,S,nkv,hs) are LOGICAL
     (full-sequence) arrays inside the enclosing jit; shard_map splits them
@@ -366,6 +403,21 @@ def sp_sdpa(q, k, v, *, scale: float, causal: bool = True,
         "sequence-parallel attention requires q and kv of equal length "
         f"(got {q.shape[1]} vs {k.shape[1]})")
 
+    rate = float(dropout_rate)
+    if rate > 0.0:
+        assert dropout_rng is not None, \
+            "dropout_rate > 0 requires a dropout_rng key"
+        seed = jax.random.randint(dropout_rng, (2,), -2 ** 31, 2 ** 31 - 1,
+                                  jnp.int32)
+        if impl == "ulysses":
+            # the ring hops' global-position-keyed mask has no ulysses
+            # equivalent (the local call sees permuted head subsets);
+            # zig-zag/ring give the same math with exact dropout
+            impl = "zigzag" if (causal and q.shape[1] % (2 * sp) == 0) \
+                else "ring"
+    else:
+        seed = jnp.zeros((2,), jnp.int32)
+
     zigzag = False
     if impl == "ulysses":
         nkv = k.shape[2]
@@ -381,20 +433,30 @@ def sp_sdpa(q, k, v, *, scale: float, causal: bool = True,
         # the contiguous schedule reachable for A/B and debugging.
         zigzag = True
         body = functools.partial(zigzag_ring_attention_local, scale=scale,
-                                 sp=sp)
+                                 sp=sp, rate=rate)
     else:
         body = functools.partial(ring_attention_local, scale=scale, sp=sp,
-                                 causal=causal)
+                                 causal=causal, rate=rate)
 
-    def shard_body(a, b, c):
+    def shard_body(a, b, c, seed_rep):
         with context.sp_region():   # no recursive sp routing inside
-            return body(a, b, c)
+            if rate > 0.0:
+                # decorrelate masks across 'data' shards; the 'seq' axis
+                # is deliberately NOT folded — global-position keying
+                # already makes seq shards consistent
+                from distributed_pytorch_tpu.ops.flash_attention import (
+                    fold_seed_for_data_shard)
+                seed_rep = fold_seed_for_data_shard(
+                    seed_rep, jax.lax.axis_index("data"))
+            return body(a, b, c, seed=seed_rep) if rate > 0.0 \
+                else body(a, b, c)
 
     spec = P("data", "seq", None, None)
     fn = jax.shard_map(shard_body, mesh=mesh,
-                       in_specs=(spec, spec, spec), out_specs=spec)
+                       in_specs=(spec, spec, spec, P(None)),
+                       out_specs=spec)
     if zigzag:
         perm, inv = zigzag_permutation(q.shape[1], sp)
-        out = fn(q[:, perm], k[:, perm], v[:, perm])
+        out = fn(q[:, perm], k[:, perm], v[:, perm], seed)
         return out[:, inv]
-    return fn(q, k, v)
+    return fn(q, k, v, seed)

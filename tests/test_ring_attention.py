@@ -101,15 +101,106 @@ def test_sdpa_decode_shapes_bypass_sp(mesh24):
                                atol=1e-6)
 
 
-def test_sp_dropout_fallback_warns(mesh24):
-    """dropout>0 under a live seq axis silently defeats the sp recipe's
-    memory purpose — ADVICE r1: it must warn, not degrade quietly."""
-    B, T, nh, hs = 2, 64, 4, 16
+def _replay_sp_keep_mask(B, T, nh, rate, rng, dp):
+    """Host replay of the sp dropout mask (ops/ring_attention
+    _hop_dropout_mask + sp_sdpa's per-data-shard seed fold)."""
+    from distributed_pytorch_tpu.ops.flash_attention import (
+        _mix_bits, dropout_threshold, fold_seed_for_data_shard)
+    seed = jax.random.randint(rng, (2,), -2 ** 31, 2 ** 31 - 1, jnp.int32)
+    shape = (B // dp, nh, T, T)
+    keeps = []
+    for d in range(dp):
+        sd = fold_seed_for_data_shard(seed, d)
+        row = (jax.lax.broadcasted_iota(jnp.uint32, shape, 0)
+               * jnp.uint32(nh)
+               + jax.lax.broadcasted_iota(jnp.uint32, shape, 1))
+        qp = jax.lax.broadcasted_iota(jnp.uint32, shape, 2)
+        kp = jax.lax.broadcasted_iota(jnp.uint32, shape, 3)
+        bits = _mix_bits(sd[0], sd[1], row, qp, kp)
+        keeps.append((np.asarray(bits) >= np.uint32(
+            dropout_threshold(rate))).astype(np.float32) / (1 - rate))
+    return np.concatenate(keeps, axis=0)               # (B, nh, T, T)
+
+
+def _sp_dropout_oracle(q, k, v, scale, rate, rng, dp):
+    """Full naive softmax, then the exact replayed keep mask, then @ v."""
+    B, T, nh, hs = q.shape
+    keep = _replay_sp_keep_mask(B, T, nh, rate, rng, dp)
+
+    nkv = k.shape[2]
+    kk = np.repeat(np.asarray(k), nh // nkv, axis=2)
+    vv = np.repeat(np.asarray(v), nh // nkv, axis=2)
+    s = np.einsum("btnh,bsnh->bnts", np.asarray(q, np.float32),
+                  kk.astype(np.float32)) * scale
+    mask = np.tril(np.ones((T, T), bool))
+    s = np.where(mask[None, None], s, -np.inf)
+    attn = np.exp(s - s.max(-1, keepdims=True))
+    attn /= attn.sum(-1, keepdims=True)
+    return np.einsum("bnts,bsnh->btnh", attn * keep, vv)
+
+
+@pytest.mark.parametrize("impl", ["ring", "zigzag"])
+def test_sp_dropout_exact_vs_replayed_mask(mesh24, impl):
+    """Round 5: dropout no longer disables sp. The einsum hops draw a
+    global-position-keyed mask, so the distributed result must EXACTLY
+    match a host oracle replaying the same mask — including the per-data-
+    shard seed fold (mesh24 is data=2 x seq=4)."""
+    B, T, nh, hs = 4, 128, 4, 16
+    scale, rate = 1.0 / hs ** 0.5, 0.3
+    rng = jax.random.PRNGKey(11)
     q, k, v = rand_qkv(jax.random.PRNGKey(8), B, T, nh, nh, hs)
     with context.use_mesh(mesh24):
-        with pytest.warns(RuntimeWarning, match="sequence-parallel"):
-            sdpa(q, k, v, causal=True, dropout_rate=0.1,
-                 dropout_rng=jax.random.PRNGKey(9), impl="auto")
+        out = sp_sdpa(q, k, v, scale=scale, causal=True, impl=impl,
+                      dropout_rate=rate, dropout_rng=rng)
+    ref = _sp_dropout_oracle(q, k, v, scale, rate, rng, dp=2)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-4, atol=2e-4)
+
+
+def test_sp_dropout_grads_match_oracle(mesh24):
+    B, T, nh, hs = 4, 64, 4, 16
+    scale, rate = 1.0 / hs ** 0.5, 0.2
+    rng = jax.random.PRNGKey(12)
+    q, k, v = rand_qkv(jax.random.PRNGKey(13), B, T, nh, nh, hs)
+    w = jax.random.normal(jax.random.PRNGKey(14), q.shape)
+
+    def f(q, k, v):
+        return jnp.sum(sp_sdpa(q, k, v, scale=scale, causal=True,
+                               impl="ring", dropout_rate=rate,
+                               dropout_rng=rng) * w)
+
+    with context.use_mesh(mesh24):
+        g = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    # oracle grads: differentiate the replayed-mask einsum directly
+    keep = jnp.asarray(_replay_sp_keep_mask(B, T, nh, rate, rng, dp=2))
+
+    def oracle(q, k, v):
+        s = jnp.einsum("btnh,bsnh->bnts", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * scale
+        cm = jnp.tril(jnp.ones((T, T), bool))
+        s = jnp.where(cm[None, None], s, -jnp.inf)
+        attn = jax.nn.softmax(s, axis=-1) * keep
+        return jnp.sum(jnp.einsum("bnts,bsnh->btnh", attn, v) * w)
+
+    g_ref = jax.grad(oracle, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_sdpa_auto_routes_dropout_to_sp(mesh24):
+    """The dispatcher must keep the sp path for dropout>0 (round-4 demoted
+    to full-sequence naive attention with a warning; round 5 composes)."""
+    B, T, nh, hs = 4, 64, 4, 16
+    q, k, v = rand_qkv(jax.random.PRNGKey(8), B, T, nh, nh, hs)
+    rng = jax.random.PRNGKey(9)
+    with context.use_mesh(mesh24):
+        out = sdpa(q, k, v, causal=True, dropout_rate=0.1,
+                   dropout_rng=rng, impl="auto")
+        ref = sp_sdpa(q, k, v, scale=1.0 / hs ** 0.5, causal=True,
+                      impl="zigzag", dropout_rate=0.1, dropout_rng=rng)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
 
 
 def test_sp_training_step_with_ring_matches_oracle():

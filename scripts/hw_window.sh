@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# One-shot hardware-window capture (round 5): the TPU tunnel comes and goes
+# on hour timescales, so the moment a probe succeeds this script grabs, in
+# priority order, everything the round needs from real silicon:
+#   1. bench.py            — the headline MFU number (its mini-sweep already
+#                            A/Bs flash/slab/streaming-CE legs, ~15 min cap)
+#   2. mfu_sweep blocks    — the flash block/layout/CE ablation inside the
+#                            real train step (decides the dispatch default)
+#   3. profile_step        — per-op device-time table of the best config
+# Everything lands under hw_capture/ for analysis + PERF.md.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+mkdir -p hw_capture
+TS=$(date -u +%m%d_%H%M)
+echo "[hw_window] TPU window open at $TS" | tee hw_capture/last_window.txt
+
+timeout 2400 python bench.py \
+    > "hw_capture/bench_$TS.json" 2> "hw_capture/bench_$TS.log"
+echo "[hw_window] bench rc=$? -> hw_capture/bench_$TS.json"
+tail -c 400 "hw_capture/bench_$TS.json" || true
+
+timeout 4500 python scripts/mfu_sweep.py --variants blocks --iters 8 \
+    2>&1 | tee "hw_capture/sweep_$TS.log"
+echo "[hw_window] sweep rc=$?"
+
+timeout 900 python scripts/profile_step.py --batch 16 --attn xla \
+    --trace_dir "hw_capture/trace_$TS" \
+    2>&1 | tee "hw_capture/profile_$TS.log"
+echo "[hw_window] profile rc=$?; capture complete"

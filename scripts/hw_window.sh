@@ -3,7 +3,10 @@
 # on hour timescales, so the moment a probe succeeds this script grabs, in
 # priority order, everything the round needs from real silicon:
 #   1. bench.py            — the headline MFU number (its mini-sweep already
-#                            A/Bs flash/slab/streaming-CE legs, ~15 min cap)
+#                            A/Bs flash/slab/streaming-CE legs; worst case
+#                            ~75 min if the tunnel goes half-up mid-bench,
+#                            so the cap is 90 min — bench always prints its
+#                            JSON line if allowed to finish)
 #   2. mfu_sweep blocks    — the flash block/layout/CE ablation inside the
 #                            real train step (decides the dispatch default)
 #   3. profile_step        — per-op device-time table of the best config
@@ -14,7 +17,7 @@ mkdir -p hw_capture
 TS=$(date -u +%m%d_%H%M)
 echo "[hw_window] TPU window open at $TS" | tee hw_capture/last_window.txt
 
-timeout 2400 python bench.py \
+timeout 5400 python bench.py \
     > "hw_capture/bench_$TS.json" 2> "hw_capture/bench_$TS.log"
 echo "[hw_window] bench rc=$? -> hw_capture/bench_$TS.json"
 tail -c 400 "hw_capture/bench_$TS.json" || true
@@ -23,7 +26,12 @@ timeout 4500 python scripts/mfu_sweep.py --variants blocks --iters 8 \
     2>&1 | tee "hw_capture/sweep_$TS.log"
 echo "[hw_window] sweep rc=$?"
 
+# profile BOTH kernel paths (pallas first — it is the one the round ships
+# if the sweep says it wins; xla is the round-4 baseline for comparison)
+timeout 900 python scripts/profile_step.py --batch 16 --attn pallas \
+    --trace_dir "hw_capture/trace_${TS}_pallas" \
+    2>&1 | tee "hw_capture/profile_${TS}_pallas.log"
 timeout 900 python scripts/profile_step.py --batch 16 --attn xla \
-    --trace_dir "hw_capture/trace_$TS" \
-    2>&1 | tee "hw_capture/profile_$TS.log"
-echo "[hw_window] profile rc=$?; capture complete"
+    --trace_dir "hw_capture/trace_${TS}_xla" \
+    2>&1 | tee "hw_capture/profile_${TS}_xla.log"
+echo "[hw_window] profiles done; capture complete"

@@ -238,7 +238,7 @@ def main() -> None:
             # No explicit config: measure the ambitious default (bigger
             # per-chip batch amortizes per-step overhead; attention-only
             # remat keeps it inside HBM) AND the conservative known-good
-            # one, report the better — a 2-point mini-sweep inside the
+            # one, report the better — a 5-leg mini-sweep inside the
             # bench budget (each leg ~2 min; compiles hit /tmp/jax_ccache
             # on reruns). A failing ambitious leg just loses its entry.
             candidates = []
@@ -252,10 +252,13 @@ def main() -> None:
                               ("batch32_remat_pallas",
                                {"BENCH_BATCH": "32", "BENCH_REMAT": "1",
                                 "BENCH_ATTN": "pallas"}),
+                              ("batch32_remat_xla",
+                               {"BENCH_BATCH": "32", "BENCH_REMAT": "1",
+                                "BENCH_ATTN": "xla"}),
                               ("batch16", None)):
                 # 900s/leg: a healthy leg is ~3 min incl. compile; the cap
                 # exists so a half-up tunnel can't eat the whole bench
-                # budget across four legs
+                # budget across the five legs (worst case 75 min)
                 r = _spawn_worker("tpu", timeout_s=900, extra_env=env)
                 if r:
                     r["config"] = name

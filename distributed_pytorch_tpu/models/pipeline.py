@@ -145,14 +145,23 @@ def run_pipeline(parent: nn.Module, cfg: LLMConfig, attn_impl: str,
     pad = jnp.zeros((L - 1, b, T, C), x.dtype)
     xs_in = jnp.concatenate([mb, pad], axis=0)          # (ticks, b, T, C)
 
+    # moe_state rides the scan carry so per-tick bias updates accumulate —
+    # but ONLY when the caller made it mutable (training). In read-only
+    # applies (eval/estimate_loss) flax drops immutable collections from
+    # the carry output, so carrying would mismatch the scan's carry pytree;
+    # broadcast is correct there (no writes to thread).
+    if parent.is_mutable_collection("moe_state"):
+        state_kw: dict = {"variable_carry": "moe_state",
+                          "variable_broadcast": "params"}
+    else:
+        state_kw = {"variable_broadcast": ["params", "moe_state"]}
     ScanTick = nn.scan(
         _PipeTick,
-        variable_broadcast="params",
-        variable_carry="moe_state",
         split_rngs={"params": False, "dropout": True},
         in_axes=(0, 0, nn.broadcast),
         out_axes=0,
         length=ticks,
+        **state_kw,
     )
     buf0 = _pipe_constraint(jnp.zeros((L, b, T, C), x.dtype))
     _, (outs, aux_per_tick) = ScanTick(

@@ -250,3 +250,16 @@ def test_pp_act_recomp_matches_plain(policy):
     _, loss_pp, _ = pp_model.apply({"params": pp_params}, idx, tgt)
     _, loss_r, _ = LLM(cfg_r).apply({"params": pp_params}, idx, tgt)
     np.testing.assert_allclose(float(loss_r), float(loss_pp), rtol=1e-6)
+
+
+def test_pp_moe_eval_apply_without_mutable():
+    """Read-only apply (eval/estimate_loss path — no mutable moe_state)
+    must work under pp x moe: caught live in round 5 when the real-data
+    run's first eval crashed with a scan-carry pytree mismatch (immutable
+    collections drop out of the carry output). alpha=0 isolates the main
+    loss — at M=2 the aux term is per-microbatch by design."""
+    loop_model, pp_model, variables, pp_vars, idx, tgt = \
+        _moe_models(2, alpha=0.0)
+    _, loss_loop, _ = loop_model.apply(variables, idx, tgt)
+    _, loss_pp, _ = pp_model.apply(pp_vars, idx, tgt)
+    np.testing.assert_allclose(float(loss_pp), float(loss_loop), rtol=1e-6)

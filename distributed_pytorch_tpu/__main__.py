@@ -8,14 +8,27 @@ fsdp_tp,ep,sp,pp}` (axis sizes compose, e.g. --parallelism fsdp
 on a TPU pod every host runs this same command (see scripts/train.sh).
 Flag surface mirrors the reference's ~33 argparse flags
 (single-gpu/train.py:136-181), including --total_batch_size_str "2**14".
+
+Ladder extras: `--preset gpt2_350m|gpt2_774m|gpt2_1p5b` (config.PRESETS)
+seeds the model defaults with a BASELINE.json ladder rung — explicit
+flags still override — and `--dryrun` prints the static HBM plan
+(micro-batch, remat policy, est. peak HBM, grad-accum; train/memplan.py)
+and exits without compiling anything.
 """
 
-from distributed_pytorch_tpu.config import build_parser, configs_from_args
+from distributed_pytorch_tpu.config import (PRESETS, build_parser,
+                                            configs_from_args)
 
 
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
-    model_cfg, train_cfg = configs_from_args(args)
+    model_defaults = None
+    if args.preset:
+        # re-parse against the preset's defaults so explicit flags win
+        model_defaults = PRESETS[args.preset]()
+        args = build_parser(model_defaults=model_defaults).parse_args(argv)
+    model_cfg, train_cfg = configs_from_args(args,
+                                             model_defaults=model_defaults)
 
     if train_cfg.platform != "auto":
         # Pin the backend BEFORE any jax device op. Env vars are not enough
@@ -24,6 +37,13 @@ def main(argv=None) -> None:
         # because backend clients are created lazily.
         import jax
         jax.config.update("jax_platforms", train_cfg.platform)
+
+    if args.dryrun:
+        from distributed_pytorch_tpu.train.memplan import plan_memory
+        plan = plan_memory(model_cfg, train_cfg,
+                           preset_name=args.preset or "custom")
+        print(plan.summary())
+        return
 
     from distributed_pytorch_tpu.train.loop import train
     train(model_cfg, train_cfg)

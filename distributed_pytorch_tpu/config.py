@@ -189,6 +189,46 @@ def flagship_gpt124m(**overrides) -> "LLMConfig":
     return LLMConfig(**base)
 
 
+def _gpt2_preset(width: int, depth: int, heads: int, up: int,
+                 **overrides) -> "LLMConfig":
+    base = dict(vocab_size=50304, block_size=1024, n_embd=width,
+                n_head=heads, n_kv_heads=heads, attn="mha",
+                n_layer=depth, up_dim=up, non_linearity="swiglu",
+                pos_emb="rope")
+    base.update(overrides)
+    return LLMConfig(**base)
+
+
+def gpt2_350m(**overrides) -> "LLMConfig":
+    """GPT-2 medium class (~351M with the gated-FFN 2/3 scaling:
+    up_dim 2688 ~= 8*1024/3 rounded to a lane multiple, reproducing
+    GPT-2's 8*C^2 FFN params/layer like flagship_gpt124m does).
+    BASELINE.json ladder rung 1 — target recipes zero1/zero2."""
+    return _gpt2_preset(1024, 24, 16, 2688, **overrides)
+
+
+def gpt2_774m(**overrides) -> "LLMConfig":
+    """GPT-2 large class (~769M; up_dim 3392 ~= 8*1280/3). Ladder rung 2 —
+    target recipe fsdp."""
+    return _gpt2_preset(1280, 36, 20, 3392, **overrides)
+
+
+def gpt2_1p5b(**overrides) -> "LLMConfig":
+    """GPT-2 XL class (~1.55B; up_dim 4224 ~= 8*1600/3; 25 heads of 64 as
+    in GPT-2 XL). Ladder rung 3 — fsdp single-host, rung 4 two-host."""
+    return _gpt2_preset(1600, 48, 25, 4224, **overrides)
+
+
+# name -> factory; the CLI's --preset flag and bench.py's ladder legs both
+# resolve through this table so a rung cannot drift between them.
+PRESETS = {
+    "gpt2_124m": flagship_gpt124m,
+    "gpt2_350m": gpt2_350m,
+    "gpt2_774m": gpt2_774m,
+    "gpt2_1p5b": gpt2_1p5b,
+}
+
+
 @dataclass(frozen=True)
 class TrainConfig:
     """Training hyperparameters. Mirrors reference `Trainconfig`
@@ -234,6 +274,12 @@ class TrainConfig:
     # variant (ops/ring_attention.py)
     attn_impl: str = "auto"  # auto | xla | pallas | naive | ring | zigzag | ulysses
     moe_impl: str = "dense"          # 'dense' | 'scatter'
+    # collective-matmul overlap for the ZeRO-3 family
+    # (ops/collective_matmul.py): 'on' fuses param all-gathers / grad
+    # reduce-scatters into ppermute rings overlapped with the matmuls;
+    # 'auto' keeps the known-good GSPMD schedule until a hardware number
+    # exists. The OVERLAP env var overrides this field (bench/sweep A/B).
+    overlap: str = "auto"            # auto | on | off
     # checkpoint/resume (exceeds reference save-only; SURVEY.md §5)
     ckpt_interval: int = 0           # 0 = end-of-run only
     resume: bool = False
@@ -250,6 +296,8 @@ class TrainConfig:
             f"unknown attn_impl {self.attn_impl!r}"
         assert self.platform in ("auto", "tpu", "cpu"), \
             f"unknown platform {self.platform!r}"
+        assert self.overlap in ("auto", "on", "off"), \
+            f"unknown overlap mode {self.overlap!r}"
         assert self.optimizer in ("adamw", "lion", "adafactor"), \
             f"unknown optimizer {self.optimizer!r}"
 
@@ -307,6 +355,14 @@ def build_parser(model_defaults: LLMConfig | None = None,
                 p.add_argument(f"--{name}", type=float, default=default)
             else:
                 p.add_argument(f"--{name}", type=str, default=default)
+    # non-dataclass driver flags (configs_from_args ignores unknown keys):
+    p.add_argument("--preset", choices=sorted(PRESETS), default=None,
+                   help="model-size preset (the 124M..1.5B ladder); "
+                        "explicit flags still override its fields")
+    p.add_argument("--dryrun", action="store_true", default=False,
+                   help="print the static HBM plan (micro-batch, remat "
+                        "policy, est. peak HBM, grad-accum) and exit "
+                        "without training")
     return p
 
 

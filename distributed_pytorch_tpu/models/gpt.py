@@ -183,6 +183,22 @@ class LLM(nn.Module):
             from distributed_pytorch_tpu.parallel import context
             emb_mat = tkn_emb.embedding.astype(dt)  # (V, C)
             loss_impl = cfg.loss_impl
+
+            def logits_fn(x_c, emb):
+                # lm-head gather as a collective matmul (the (V, C)
+                # embedding is the largest single param ZeRO-3 shards):
+                # under OVERLAP=on the per-chunk logits matmul rings the
+                # vocab shards; the dispatcher declines everywhere else
+                # and the default plain matmul is bit-identical
+                from distributed_pytorch_tpu.ops.collective_matmul import (
+                    maybe_overlap_matmul)
+                from distributed_pytorch_tpu.ops.losses import \
+                    _default_logits
+                y = maybe_overlap_matmul(x_c, emb,
+                                         names=("tkn_emb", "embedding"),
+                                         transpose_b=True,
+                                         out_dtype=jnp.float32)
+                return y if y is not None else _default_logits(x_c, emb)
             if loss_impl == "pallas":
                 # Streaming-kernel gates: no vocab-parallel embedding (tp
                 # shards V and the kernel's logsumexp is per-shard-local),
@@ -216,12 +232,15 @@ class LLM(nn.Module):
                     main_loss = sp_fused_cross_entropy(
                         x, emb_mat, targets, chunk=cfg.loss_chunk)
                 else:
-                    main_loss = unchunked_cross_entropy(x, emb_mat, targets)
+                    main_loss = unchunked_cross_entropy(
+                        x, emb_mat, targets, logits_fn=logits_fn)
             elif loss_impl == "fused":
                 main_loss = fused_cross_entropy(
-                    x, emb_mat, targets, chunk=cfg.loss_chunk)
+                    x, emb_mat, targets, chunk=cfg.loss_chunk,
+                    logits_fn=logits_fn)
             elif loss_impl != "pallas":
-                main_loss = unchunked_cross_entropy(x, emb_mat, targets)
+                main_loss = unchunked_cross_entropy(
+                    x, emb_mat, targets, logits_fn=logits_fn)
             loss = main_loss + total_aux / cfg.n_layer
             # full logits stay available to callers (tests, analysis); when
             # unused — as in the trainer, which takes only `loss` — XLA

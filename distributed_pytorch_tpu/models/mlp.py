@@ -86,13 +86,26 @@ def _is_gated(name: str) -> bool:
 
 
 def mlp_apply(x: jnp.ndarray, w_fc: jnp.ndarray, w_proj: jnp.ndarray,
-              non_linearity: str) -> jnp.ndarray:
+              non_linearity: str, *, overlap: bool = False) -> jnp.ndarray:
     """Apply one MLP given its kernels; shared by dense MLP and experts.
 
     Gated variants ('swiglu'/'glu'): w_fc is (C, 2*up_dim), split in half,
     h = act(x1) * x2 (reference model.py:389-391). Others: (C, up_dim).
+
+    `overlap=True` (dense MLP only — expert kernels are 3D/vmapped) offers
+    both matmuls to the collective-matmul dispatcher
+    (ops/collective_matmul.py): under an active OVERLAP=on ZeRO-3 step the
+    param all-gather runs as a ppermute ring fused with the matmul;
+    otherwise the dispatcher declines and the plain `@` below is
+    bit-identical to the pre-overlap code path.
     """
-    h = x @ w_fc
+    h = None
+    if overlap:
+        from distributed_pytorch_tpu.ops.collective_matmul import (
+            maybe_overlap_matmul)
+        h = maybe_overlap_matmul(x, w_fc, names=("c_fc",))
+    if h is None:
+        h = x @ w_fc
     if _is_gated(non_linearity):
         x1, x2 = jnp.split(h, 2, axis=-1)
         gate = jax.nn.silu(x1) if non_linearity.lower() == "swiglu" \
@@ -100,7 +113,14 @@ def mlp_apply(x: jnp.ndarray, w_fc: jnp.ndarray, w_proj: jnp.ndarray,
         h = gate * x2
     else:
         h = _activation(non_linearity)(h)
-    return h @ w_proj
+    y = None
+    if overlap:
+        from distributed_pytorch_tpu.ops.collective_matmul import (
+            maybe_overlap_matmul)
+        y = maybe_overlap_matmul(h, w_proj, names=("c_proj",))
+    if y is None:
+        y = h @ w_proj
+    return y
 
 
 class MLP(nn.Module):
@@ -116,7 +136,7 @@ class MLP(nn.Module):
         w_fc = self.param("c_fc", _DENSE_INIT, (C, fc_out), jnp.float32)
         w_proj = self.param("c_proj", _DENSE_INIT, (up, C), jnp.float32)
         y = mlp_apply(x, w_fc.astype(x.dtype), w_proj.astype(x.dtype),
-                      cfg.non_linearity)
+                      cfg.non_linearity, overlap=True)
         return nn.Dropout(cfg.dropout, deterministic=deterministic)(y)
 
 
@@ -210,7 +230,9 @@ class MoE(nn.Module):
         aux-free bias update) without touching the token outputs: the
         pipeline schedule (models/pipeline.py) passes 0.0 for buffer slots
         holding no real microbatch so their deterministic zero-token routing
-        can't pollute the load balance. None/1.0 elsewhere."""
+        can't pollute the load balance, and 1/M for valid slots so the
+        per-optimizer-step bias movement and aux total are microbatch-
+        count-invariant. None/1.0 elsewhere."""
         cfg = self.config
         B, T, C = x.shape
         sw = 1.0 if stats_weight is None else stats_weight

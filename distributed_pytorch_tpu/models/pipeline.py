@@ -70,9 +70,15 @@ class _PipeTick(nn.Module):
 
     `tick` (scanned alongside the microbatch stream) marks which slots hold
     a real microbatch: slot i is valid iff 0 <= tick - i < M. MoE blocks
-    get that validity as `stats_weight`, zeroing the aux loss and the
-    aux-free bias update for bubble slots whose all-zero tokens would
-    otherwise route deterministically and skew the load statistics."""
+    get that validity as `stats_weight = valid / M`, zeroing the aux loss
+    and the aux-free bias update for bubble slots whose all-zero tokens
+    would otherwise route deterministically and skew the load statistics.
+    The 1/M scaling makes the per-OPTIMIZER-STEP totals microbatch-count-
+    invariant: the bias moves by gamma * mean-over-microbatches(delta)
+    per step (matching the loop model's single full-batch gamma step
+    instead of taking M full-size steps — round-5 ADVICE), and the summed
+    aux term is already the per-microbatch mean (run_pipeline adds it
+    without a further /M)."""
 
     config: LLMConfig
     attn_impl: str = "auto"
@@ -87,7 +93,7 @@ class _PipeTick(nn.Module):
         buf = _pipe_constraint(buf.at[0].set(x_in))
         slot_mb = tick - jnp.arange(L)                   # microbatch in slot i
         valid = ((slot_mb >= 0) & (slot_mb < self.n_microbatches)
-                 ).astype(jnp.float32)                   # (L,)
+                 ).astype(jnp.float32) / self.n_microbatches  # (L,)
         # both remat granularities apply per virtual stage, mirroring the
         # loop model (gpt.py): 'attn' via Block's own remat_attn, 'block'
         # by wrapping the vmapped Block
@@ -125,9 +131,11 @@ def run_pipeline(parent: nn.Module, cfg: LLMConfig, attn_impl: str,
     per-microbatch aux loss — at M=1 bit-identical to the loop model's
     full-batch aux; at M>1 the load statistics are per-microbatch, the
     same granularity the reference's DDP training has per-rank (no aux
-    sync anywhere in kaggle-zero*.py). The aux-free bias likewise updates
-    once per (layer, microbatch) — M gamma-steps per optimizer step
-    instead of the loop model's one; bubble slots are masked out entirely
+    sync anywhere in kaggle-zero*.py). The aux-free bias updates once per
+    (layer, microbatch) with the delta scaled by 1/M (stats_weight in
+    _PipeTick), so per optimizer step the bias moves by gamma * the mean
+    microbatch delta — invariant to M, matching the loop model beyond
+    M=1 (round-5 ADVICE); bubble slots are masked out entirely
     (stats_weight=0), so no zero-token routing pollutes either statistic."""
     B, T, C = x.shape
     L = cfg.n_layer
@@ -170,9 +178,9 @@ def run_pipeline(parent: nn.Module, cfg: LLMConfig, attn_impl: str,
                                       jnp.arange(ticks, dtype=jnp.int32),
                                       freqs)
     # outs[t] is valid for t >= L-1: microbatch t-(L-1) fully processed;
-    # aux_per_tick sums masked per-layer aux, so /M is the per-microbatch
-    # mean (see docstring)
-    return outs[L - 1:].reshape(B, T, C), jnp.sum(aux_per_tick) / M
+    # aux_per_tick sums per-layer aux already weighted by 1/M
+    # (stats_weight), so the plain sum IS the per-microbatch mean
+    return outs[L - 1:].reshape(B, T, C), jnp.sum(aux_per_tick)
 
 
 def stack_block_params(params: dict, n_layer: int) -> dict:

@@ -66,16 +66,18 @@ def _shard_map_over_data(fn, q, has_rng: bool = False):
                 rng = jax.random.fold_in(rng, jax.lax.axis_index("data"))
                 return fn(a, b, c, rng)
 
-        return jax.shard_map(body, mesh=mesh,
-                             in_specs=(spec, spec, spec, P()),
-                             out_specs=spec)
+        from distributed_pytorch_tpu import compat
+        return compat.shard_map(body, mesh=mesh,
+                                in_specs=(spec, spec, spec, P()),
+                                out_specs=spec)
 
     def body(a, b, c):
         with context.sp_region():
             return fn(a, b, c)
 
-    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                         out_specs=spec)
+    from distributed_pytorch_tpu import compat
+    return compat.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                            out_specs=spec)
 
 
 def _naive_sdpa(q, k, v, *, scale, q_offset, dropout_rate=0.0,

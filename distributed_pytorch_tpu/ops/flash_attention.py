@@ -74,7 +74,9 @@ DEFAULT_LAYOUT = os.environ.get("FLASH_LAYOUT", "rows")
 
 _NEG_INF = -1e30  # large-negative instead of -inf: keeps masked rows NaN-free
 
-_SEMANTICS = pltpu.CompilerParams(
+from distributed_pytorch_tpu.compat import tpu_compiler_params, vma_of
+
+_SEMANTICS = tpu_compiler_params(
     dimension_semantics=("parallel", "parallel", "arbitrary"))
 
 
@@ -177,7 +179,7 @@ def _sds(shape, dtype, like):
     """ShapeDtypeStruct carrying `like`'s varying-manual-axes set: pallas
     calls inside shard_map (the ring-attention hop path) must declare how
     their outputs vary across mesh axes."""
-    vma = getattr(jax.typeof(like), "vma", None)
+    vma = vma_of(like)
     if vma is None:  # jax without vma tracking
         return jax.ShapeDtypeStruct(shape, dtype)
     return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
@@ -240,12 +242,20 @@ def _pick_group(n_rows: int, rep: int, preferred: int,
             g -= 1
             while g > 1 and n_rows % g != 0:
                 g -= 1
-        if g != req:
-            import sys
-            print(f"[flash] row group shrunk {req} -> {g} to fit the "
-                  f"{_VMEM_BUDGET >> 20} MiB VMEM budget at blocks "
-                  f"({block_q}, {block_k})", file=sys.stderr)
+        if g != req and (req, g, block_q, block_k) not in _SHRINK_WARNED:
+            # once per unique config: this runs at TRACE time, and repeated
+            # jit traces / vmap would otherwise spam a bare stderr print
+            # for every retrace (round-5 ADVICE)
+            _SHRINK_WARNED.add((req, g, block_q, block_k))
+            import warnings
+            warnings.warn(
+                f"[flash] row group shrunk {req} -> {g} to fit the "
+                f"{_VMEM_BUDGET >> 20} MiB VMEM budget at blocks "
+                f"({block_q}, {block_k})", RuntimeWarning, stacklevel=2)
     return max(g, 1)
+
+
+_SHRINK_WARNED: set = set()
 
 
 # ---------------------------------------------------------------------------
@@ -816,7 +826,11 @@ def slab_attention_usable(B, T, S, nh, nkv, hs, dtype,
     if not (bq and bk):
         return False
     dsize = jnp.dtype(dtype).itemsize
-    return _vmem_bytes(nh, nkv, bq, bk, hs, dsize) <= _VMEM_BUDGET
+    # GQA: _load_hbd jnp.repeat-expands K/V to nh heads IN VMEM (only the
+    # HBM tiles stay at nkv), so the budget must count the post-repeat
+    # intermediates at nh — gk=nkv here under-estimated exactly the
+    # overflow this gate exists to prevent (round-5 ADVICE)
+    return _vmem_bytes(nh, nh, bq, bk, hs, dsize) <= _VMEM_BUDGET
 
 
 # One custom_vjp serves both public entries: (out, lse) with the lse

@@ -50,7 +50,9 @@ DEFAULT_BLOCK_V = int(os.environ.get("CE_BLOCK_V", "2048"))    # vocab
 
 _NEG_INF = -1e30
 
-_SEMANTICS = pltpu.CompilerParams(
+from distributed_pytorch_tpu.compat import tpu_compiler_params
+
+_SEMANTICS = tpu_compiler_params(
     dimension_semantics=("parallel", "arbitrary"))
 
 
@@ -321,12 +323,12 @@ def pallas_cross_entropy(x: jnp.ndarray, embedding: jnp.ndarray,
     mesh = context.get_mesh()
     if mesh is not None and mesh.shape.get("data", 1) > 1 \
             and not context.in_sp_region():
-        nll = jax.shard_map(
+        from distributed_pytorch_tpu import compat
+        nll = compat.shard_map(
             lambda xs, w, ts: local_nll(xs, w, ts),
             mesh=mesh,
             in_specs=(P("data"), P(), P("data")),
             out_specs=P("data"),
-            check_vma=False,
         )(x, embedding, safe_t)
     else:
         nll = local_nll(x, embedding, safe_t)

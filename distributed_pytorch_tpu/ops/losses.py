@@ -35,14 +35,22 @@ import jax
 import jax.numpy as jnp
 
 
+def _default_logits(x: jnp.ndarray, embedding: jnp.ndarray) -> jnp.ndarray:
+    """x (..., C) @ embedding^T (V, C) -> (..., V) fp32 — the plain GSPMD
+    lm-head matmul. Callers may override with `logits_fn` (gpt.py routes
+    the collective-matmul ring through it under OVERLAP=on)."""
+    return jax.lax.dot_general(
+        x, embedding, (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
 def unchunked_cross_entropy(x: jnp.ndarray, embedding: jnp.ndarray,
                             targets: jnp.ndarray, *,
-                            ignore_index: int = -1) -> jnp.ndarray:
+                            ignore_index: int = -1,
+                            logits_fn=None) -> jnp.ndarray:
     """Mean CE over valid targets, full (B, T, V) logits (semantics oracle;
     mirrors reference model.py:687-692 incl. ignore_index=-1)."""
-    logits = jax.lax.dot_general(
-        x, embedding, (((x.ndim - 1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)          # (B, T, V) fp32
+    logits = (logits_fn or _default_logits)(x, embedding)  # (B, T, V) fp32
     mask = targets != ignore_index
     safe = jnp.where(mask, targets, 0)
     logp = jax.nn.log_softmax(logits, axis=-1)
@@ -70,7 +78,7 @@ def _chunk_for(T: int, V: int, target_tokens: int = 128,
 
 def _nll_sum_chunked(x: jnp.ndarray, embedding: jnp.ndarray,
                      targets: jnp.ndarray, ignore_index: int,
-                     chunk: int):
+                     chunk: int, logits_fn=None):
     """(sum of nll over valid targets, valid count) with the T axis chunked
     through a rematerialized scan — the shared core of fused_cross_entropy
     and the sequence-parallel local body. Falls back to one unchunked block
@@ -81,9 +89,8 @@ def _nll_sum_chunked(x: jnp.ndarray, embedding: jnp.ndarray,
         chunk = _chunk_for(T, V)
 
     def block_nll(x_c, t_c):
-        logits = jax.lax.dot_general(
-            x_c, embedding, (((2,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)      # (B, chunk, V) fp32
+        logits = (logits_fn or _default_logits)(x_c, embedding)
+        # (B, chunk, V) fp32
         mask = t_c != ignore_index
         safe = jnp.where(mask, t_c, 0)
         lse = jax.nn.logsumexp(logits, axis=-1)
@@ -149,7 +156,9 @@ def sp_fused_cross_entropy(x: jnp.ndarray, embedding: jnp.ndarray,
         return s / jnp.maximum(n, 1)
 
     from jax.sharding import PartitionSpec as P
-    fn = jax.shard_map(
+
+    from distributed_pytorch_tpu import compat
+    fn = compat.shard_map(
         local_body, mesh=mesh,
         in_specs=(P("data", "seq", None), P(None, None), P("data", "seq")),
         out_specs=P())
@@ -159,14 +168,15 @@ def sp_fused_cross_entropy(x: jnp.ndarray, embedding: jnp.ndarray,
 def fused_cross_entropy(x: jnp.ndarray, embedding: jnp.ndarray,
                         targets: jnp.ndarray, *,
                         ignore_index: int = -1,
-                        chunk: int = 0) -> jnp.ndarray:
+                        chunk: int = 0, logits_fn=None) -> jnp.ndarray:
     """Chunked weight-tied CE: logits are computed (and re-computed in
     backward) one T-chunk at a time; the (B, T, V) block never exists.
 
     x: (B, T, C) hidden states (compute dtype); embedding: (V, C);
     targets: (B, T) int with `ignore_index` masking. `chunk=0` picks a
     divisor of T automatically (or falls back to the unchunked oracle when
-    chunking can't help).
+    chunking can't help). `logits_fn(x_chunk, embedding)` overrides the
+    per-chunk lm-head matmul (collective-matmul routing, gpt.py).
     """
     B, T, C = x.shape
     V = embedding.shape[0]
@@ -174,7 +184,8 @@ def fused_cross_entropy(x: jnp.ndarray, embedding: jnp.ndarray,
         chunk = _chunk_for(T, V)
     if chunk <= 0 or T % chunk != 0 or T // chunk <= 1:
         return unchunked_cross_entropy(x, embedding, targets,
-                                       ignore_index=ignore_index)
+                                       ignore_index=ignore_index,
+                                       logits_fn=logits_fn)
     total, count = _nll_sum_chunked(x, embedding, targets, ignore_index,
-                                    chunk)
+                                    chunk, logits_fn=logits_fn)
     return total / jnp.maximum(count, 1)

@@ -46,6 +46,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from distributed_pytorch_tpu import compat
+
 _NEG_INF = -1e30
 
 
@@ -125,10 +127,8 @@ def _init_carry(q, nh: int, Tq: int):
     acc = jnp.zeros((B, nh, Tq, D), jnp.float32)
     m = jnp.full((B, nh, Tq, 1), _NEG_INF, jnp.float32)
     l = jnp.zeros((B, nh, Tq, 1), jnp.float32)
-    vma = tuple(jax.typeof(q).vma)
-    if vma:
-        acc, m, l = (jax.lax.pcast(t, vma, to="varying")
-                     for t in (acc, m, l))
+    vma = compat.vma_of(q)
+    acc, m, l = (compat.pcast_varying(t, vma) for t in (acc, m, l))
     return acc, m, l
 
 
@@ -155,9 +155,8 @@ def _init_flash_carry(q, nh: int, Tq: int):
     B, D = q.shape[0], q.shape[3]
     out = jnp.zeros((B, Tq, nh, D), jnp.float32)
     lse = jnp.full((B, Tq, nh), _NEG_INF, jnp.float32)
-    vma = tuple(jax.typeof(q).vma)
-    if vma:
-        out, lse = (jax.lax.pcast(t, vma, to="varying") for t in (out, lse))
+    vma = compat.vma_of(q)
+    out, lse = (compat.pcast_varying(t, vma) for t in (out, lse))
     return out, lse
 
 
@@ -452,9 +451,9 @@ def sp_sdpa(q, k, v, *, scale: float, causal: bool = True,
                 else body(a, b, c)
 
     spec = P("data", "seq", None, None)
-    fn = jax.shard_map(shard_body, mesh=mesh,
-                       in_specs=(spec, spec, spec, P(None)),
-                       out_specs=spec)
+    fn = compat.shard_map(shard_body, mesh=mesh,
+                          in_specs=(spec, spec, spec, P(None)),
+                          out_specs=spec)
     if zigzag:
         perm, inv = zigzag_permutation(q.shape[1], sp)
         out = fn(q[:, perm], k[:, perm], v[:, perm], seed)

@@ -59,3 +59,50 @@ def use_mesh(mesh: Optional[Mesh]):
         yield mesh
     finally:
         _current_mesh.reset(token)
+
+
+# --- collective-matmul overlap context (ops/collective_matmul.py) ----------
+#
+# The train step publishes (overlap mode, recipe) for the duration of
+# TRACING, exactly like the mesh above: the model's matmul call sites are
+# recipe-oblivious, but the overlap dispatcher needs to know whether the
+# ZeRO-3 family shards the params it is about to ring over.
+
+_overlap_state: ContextVar[tuple[str, str]] = ContextVar(
+    "overlap_state", default=("auto", "single"))
+
+
+def overlap_state() -> tuple[str, str]:
+    """(overlap mode, parallelism recipe) published by the enclosing train
+    step; ("auto", "single") outside one."""
+    return _overlap_state.get()
+
+
+@contextlib.contextmanager
+def use_overlap(mode: str, recipe: str):
+    token = _overlap_state.set((mode, recipe))
+    try:
+        yield
+    finally:
+        _overlap_state.reset(token)
+
+
+_gathers_hoisted: ContextVar[bool] = ContextVar("gathers_hoisted",
+                                                default=False)
+
+
+def gathers_hoisted() -> bool:
+    """True while tracing a step whose param all-gathers were hoisted out of
+    the grad-accumulation scan (train/step.py): the params reaching the
+    matmuls are already full, so the collective-matmul rings must stand
+    down (ringing a replicated tensor would re-scatter then re-gather)."""
+    return _gathers_hoisted.get()
+
+
+@contextlib.contextmanager
+def hoisted_gathers(on: bool = True):
+    token = _gathers_hoisted.set(on)
+    try:
+        yield
+    finally:
+        _gathers_hoisted.reset(token)

@@ -77,6 +77,16 @@ def save_checkpoint_async(path: str, state: TrainState,
         _async_ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
     _async_ckptr.wait_until_finished()
     path = _abs(path)
+    # Donation-proof snapshot: the train step donates its state argument
+    # (train/step.py donate_argnums=(0,)), so the buffers behind `state`
+    # are REUSED by the very next optimizer step while orbax's background
+    # thread is still reading them — observed live on the CPU mesh: an
+    # interval save at it=4 persisted state.step == 7 (the run's final
+    # state), which made --resume skip the remaining iterations entirely.
+    # .copy() allocates fresh buffers with the same sharding; the copy is
+    # the usual async-checkpoint snapshot cost, paid explicitly.
+    state = jax.tree_util.tree_map(
+        lambda x: x.copy() if isinstance(x, jax.Array) else x, state)
     _async_ckptr.save(os.path.join(path, "state"),
                       args=ocp.args.StandardSave(state), force=True)
     _write_meta(path, state, model_cfg, train_cfg)
@@ -112,8 +122,14 @@ def restore_checkpoint(path: str, abstract_state: Any,
         lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
         abstract_state, state_sharding)
     with ocp.StandardCheckpointer() as ckptr:
-        return ckptr.restore(os.path.join(_abs(path), "state"),
-                             abstract_state)
+        state = ckptr.restore(os.path.join(_abs(path), "state"),
+                              abstract_state)
+    # Re-buffer through XLA before the trainer donates this state into the
+    # jitted step: orbax's restore can hand back arrays whose buffers XLA
+    # does not own, and donating those corrupts the heap on jax 0.4.x
+    # (observed: "corrupted double-linked list" aborts right after resume).
+    return jax.tree_util.tree_map(
+        lambda x: x.copy() if isinstance(x, jax.Array) else x, state)
 
 
 def restore_for_inference(path: str, abstract_state: Any,
@@ -130,15 +146,22 @@ def restore_for_inference(path: str, abstract_state: Any,
     if shardings is None:
         one = jax.sharding.SingleDeviceSharding(jax.local_devices()[0])
         shardings = jax.tree_util.tree_map(lambda s: one, abstract_state)
+    placeholder = getattr(ocp, "PLACEHOLDER", None)
+    if placeholder is None:
+        # older orbax (no partial-restore placeholder): restore the full
+        # state and drop opt_state after the fact — same result, reads the
+        # extra bytes the placeholder path exists to skip
+        state = restore_checkpoint(path, abstract_state, shardings)
+        return dataclasses.replace(state, opt_state=None)
     abstract_state = dataclasses.replace(
         jax.tree_util.tree_map(
             lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype,
                                                sharding=sh),
             abstract_state, shardings),
-        opt_state=jax.tree_util.tree_map(lambda _: ocp.PLACEHOLDER,
+        opt_state=jax.tree_util.tree_map(lambda _: placeholder,
                                          abstract_state.opt_state))
     restore_args = jax.tree_util.tree_map(
-        lambda s: s if s is ocp.PLACEHOLDER else
+        lambda s: s if s is placeholder else
         ocp.checkpoint_utils.construct_restore_args(s),
         abstract_state)
     with ocp.Checkpointer(ocp.PyTreeCheckpointHandler()) as ckptr:

@@ -83,7 +83,8 @@ def maybe_initialize_distributed() -> None:
     state, touches no backend)."""
     if not multihost_env_detected():
         return
-    if jax.distributed.is_initialized():
+    from distributed_pytorch_tpu import compat
+    if compat.distributed_is_initialized():
         return
     # jax.distributed.initialize() auto-detects only TPU-pod / Slurm / MPI
     # environments; the explicit JAX_* env convention (our launchers, and

@@ -1,0 +1,233 @@
+"""Static HBM budget estimator + micro-batch / remat planner.
+
+Opens the 350M-1.5B config ladder (BASELINE.json) without burning a
+hardware window on OOM bisection: given a model config, a recipe, and a
+per-chip HBM budget, `plan_memory` estimates the resident bytes of every
+tensor class the recipe implies (fp32 params / AdamW moments / grad
+accumulator — each divided by dp exactly when the recipe's sharding tables
+shard it — plus per-micro-batch activations under each remat policy and
+the fused-CE logits chunk) and picks the largest micro-batch x cheapest
+remat policy that fits, with the grad-accum arithmetic
+(global batch tokens / devices / micro-batch) solved at the same time.
+
+Everything here is closed-form or jax.eval_shape (trace-only): no compile,
+no allocation — `--dryrun` prints a 1.5B plan from a laptop CPU in
+seconds. The estimate is deliberately conservative (activation bytes use a
+per-token-per-layer formula derived from what the backward actually keeps
+alive, times a 15% fragmentation/XLA-temp fudge); the first TPU window
+validates the constants against `peak_bytes_in_use` and PERF.md records
+the delta.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import numpy as np
+
+from distributed_pytorch_tpu.config import LLMConfig, TrainConfig
+from distributed_pytorch_tpu.parallel.sharding import (_GRAD_SHARDED,
+                                                       _OPT_SHARDED,
+                                                       _PARAM_SHARDED)
+
+# Per-chip HBM by device-kind substring (GiB, spec-sheet numbers; first
+# match wins — same matching scheme as metrics._PEAK_FLOPS).
+_HBM_GB = (
+    ("v6", 32.0),       # Trillium
+    ("v5p", 95.0),
+    ("v5", 16.0),       # v5e
+    ("v4", 32.0),
+    ("v3", 32.0),
+    ("v2", 16.0),
+)
+_DEFAULT_HBM_GB = 16.0  # plan for a v5e when the backend is CPU/unknown
+
+# optimizer moment multiplier (x param bytes, fp32)
+_OPT_MULT = {"adamw": 2.0, "lion": 1.0, "adafactor": 0.1}
+
+_FUDGE = 1.15  # fragmentation + XLA temporaries
+
+
+def device_hbm_gb() -> float:
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:  # pragma: no cover
+        return _DEFAULT_HBM_GB
+    for key, val in _HBM_GB:
+        if key in kind:
+            return val
+    return _DEFAULT_HBM_GB
+
+
+def param_count(cfg: LLMConfig) -> int:
+    """Exact parameter count via jax.eval_shape of the real model init —
+    trace-only, so a 1.5B count costs milliseconds and cannot drift from
+    the model code the way a hand-maintained formula would."""
+    from distributed_pytorch_tpu.models.gpt import LLM
+    import jax.numpy as jnp
+
+    model = LLM(cfg)
+    dummy = jax.ShapeDtypeStruct((1, cfg.block_size), jnp.int32)
+    rng = jax.ShapeDtypeStruct((2,), jnp.uint32)
+    variables = jax.eval_shape(
+        lambda r, x: model.init(
+            {"params": r, "dropout": r}, x, x), rng, dummy)
+    return sum(int(np.prod(l.shape))
+               for l in jax.tree_util.tree_leaves(variables["params"]))
+
+
+def _act_bytes_per_token_layer(cfg: LLMConfig, policy: str,
+                               dtype_bytes: int = 2) -> float:
+    """Backward-live activation bytes per token per layer under a remat
+    policy ('none' | 'attn' | 'block').
+
+    'none' keeps every matmul input: ln1 out (C), fused qkv
+    (C + 2*nkv*hs), sdpa out (C), proj out (C), ln2 out (C), fc out
+    (fc_out), gated hidden (up), mlp proj out (C) — the flash kernel keeps
+    no O(T^2) probabilities, only the per-row lse (nh).  'attn' drops the
+    attention internals (recomputed blockwise), keeping the block input +
+    the MLP side. 'block' keeps only the block input; one layer's full set
+    stays as the recompute peak (added by the caller once, not x L)."""
+    C, up = cfg.n_embd, cfg.up_dim
+    nkv, hs, nh = cfg.n_kv_heads, cfg.head_size, cfg.n_head
+    fc_out = 2 * up if cfg.non_linearity.lower() in ("swiglu", "glu") else up
+    attn_part = C + (C + 2 * nkv * hs) + C + nh / dtype_bytes
+    mlp_part = C + fc_out + up + C
+    full = C + attn_part + mlp_part
+    if policy == "none":
+        return full * dtype_bytes
+    if policy == "attn":
+        return (2 * C + mlp_part) * dtype_bytes
+    return C * dtype_bytes  # 'block': residual stream input only
+
+
+@dataclasses.dataclass(frozen=True)
+class HBMPlan:
+    preset: str
+    recipe: str
+    micro_batch: int          # per-data-shard sequences (TrainConfig.batch_size)
+    grad_accum: int
+    act_recomp: bool
+    act_recomp_policy: str    # 'block' | 'attn' (meaningful when act_recomp)
+    est_peak_gb: float
+    hbm_gb: float
+    fits: bool
+    breakdown_gb: dict
+
+    def summary(self) -> str:
+        pol = self.act_recomp_policy if self.act_recomp else "none"
+        fit = "fits" if self.fits else "DOES NOT FIT"
+        b = ", ".join(f"{k} {v:.2f}" for k, v in self.breakdown_gb.items())
+        return (f"[hbm plan] {self.preset}/{self.recipe}: micro_batch="
+                f"{self.micro_batch} grad_accum={self.grad_accum} "
+                f"remat={pol} | est peak {self.est_peak_gb:.2f} GiB of "
+                f"{self.hbm_gb:.0f} GiB ({fit}) | {b}")
+
+
+def estimate_peak_gb(cfg: LLMConfig, recipe: str, micro_batch: int,
+                     policy: str, dp: int, sp: int = 1,
+                     optimizer: str = "adamw",
+                     n_params: Optional[int] = None) -> tuple[float, dict]:
+    """(est peak GiB per device, breakdown dict). `policy` in
+    'none'|'attn'|'block'. `micro_batch` is per-data-shard sequences."""
+    P = n_params if n_params is not None else param_count(cfg)
+    p_div = dp if recipe in _PARAM_SHARDED else 1
+    o_div = dp if recipe in _OPT_SHARDED else 1
+    g_div = dp if recipe in _GRAD_SHARDED else 1
+
+    params_b = P * 4 / p_div
+    opt_b = P * 4 * _OPT_MULT.get(optimizer, 2.0) / o_div
+    grads_b = P * 4 / g_div  # fp32 accumulator (train/step.py)
+
+    T_local = cfg.block_size // max(sp, 1)
+    tokens = micro_batch * T_local
+    act_b = tokens * cfg.n_layer * _act_bytes_per_token_layer(cfg, policy)
+    if policy == "block":
+        # recompute peak: one layer's full activation set lives during its
+        # backward segment
+        act_b += tokens * _act_bytes_per_token_layer(cfg, "none")
+    # embedding output + final-LN + rope residuals, bf16
+    act_b += tokens * cfg.n_embd * 2 * 3
+    # fused-CE logits chunk (fp32), forward+backward block pair
+    chunk = cfg.loss_chunk or min(128, cfg.block_size)
+    loss_b = 2 * micro_batch * chunk * cfg.vocab_size * 4
+    # the ZeRO-3 gather working set: with OVERLAP rings or GSPMD streaming
+    # gathers, roughly the largest layer's full params in compute dtype
+    # live at once; with hoisted gathers (grad accum) the whole model does.
+    if recipe in _PARAM_SHARDED:
+        per_layer = (P - cfg.vocab_size * cfg.n_embd) / max(cfg.n_layer, 1)
+        gather_b = max(per_layer, cfg.vocab_size * cfg.n_embd) * 2 * 2
+    else:
+        gather_b = 0.0
+
+    breakdown = {
+        "params": params_b / 2 ** 30,
+        "opt": opt_b / 2 ** 30,
+        "grads": grads_b / 2 ** 30,
+        "acts": act_b / 2 ** 30,
+        "loss": loss_b / 2 ** 30,
+        "gather": gather_b / 2 ** 30,
+    }
+    total = sum(breakdown.values()) * _FUDGE
+    return total, {k: round(v, 3) for k, v in breakdown.items()}
+
+
+def plan_memory(model_cfg: LLMConfig, train_cfg: TrainConfig, *,
+                n_devices: Optional[int] = None,
+                hbm_gb: Optional[float] = None,
+                preset_name: str = "custom") -> HBMPlan:
+    """Pick (micro_batch, remat policy, grad_accum) for the config under
+    the recipe's sharding and the per-chip HBM budget.
+
+    Candidates are scored by a throughput proxy — micro-batch size divided
+    by the policy's FLOP multiplier (none 1.0, attn ~1.1, block 4/3) — so
+    a bigger batch only wins if its extra remat FLOPs don't eat the gain.
+    Falls back to the smallest-batch/block-remat candidate (marked
+    fits=False) when nothing fits, so callers always get arithmetic that
+    satisfies the grad-accum divisibility contract (train/loop.py)."""
+    from distributed_pytorch_tpu.parallel.mesh import resolve_plan
+
+    recipe = train_cfg.parallelism
+    if n_devices is None:
+        n_devices = len(jax.devices())
+    plan = resolve_plan(recipe, n_devices, tp_size=train_cfg.tp_size,
+                        ep_size=train_cfg.ep_size, sp_size=train_cfg.sp_size,
+                        pp_size=train_cfg.pp_size, dp_size=train_cfg.dp_size)
+    dp, sp = plan.data, plan.seq
+    budget = hbm_gb if hbm_gb is not None else device_hbm_gb()
+    n_params = param_count(model_cfg)
+    T = model_cfg.block_size
+
+    flop_mult = {"none": 1.0, "attn": 1.1, "block": 4.0 / 3.0}
+    best = None       # (score, plan)
+    fallback = None   # smallest candidate even if over budget
+    for mb in (64, 32, 16, 8, 4, 2, 1):
+        tokens_per_micro = mb * dp * T
+        if train_cfg.total_batch_size % tokens_per_micro != 0:
+            continue
+        accum = train_cfg.total_batch_size // tokens_per_micro
+        for policy in ("none", "attn", "block"):
+            est, breakdown = estimate_peak_gb(
+                model_cfg, recipe, mb, policy, dp, sp,
+                optimizer=train_cfg.optimizer, n_params=n_params)
+            cand = HBMPlan(
+                preset=preset_name, recipe=recipe, micro_batch=mb,
+                grad_accum=accum, act_recomp=policy != "none",
+                act_recomp_policy=policy if policy != "none" else "attn",
+                est_peak_gb=round(est, 3), hbm_gb=budget,
+                fits=est <= budget, breakdown_gb=breakdown)
+            if cand.fits:
+                score = mb / flop_mult[policy]
+                if best is None or score > best[0]:
+                    best = (score, cand)
+            fallback = cand  # last = smallest batch, heaviest remat
+    if best is not None:
+        return best[1]
+    if fallback is None:
+        raise ValueError(
+            f"total_batch_size {train_cfg.total_batch_size} admits no "
+            f"micro-batch with dp={dp}, T={T} (need divisibility by "
+            f"micro_batch*dp*T)")
+    return fallback

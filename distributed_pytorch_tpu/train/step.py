@@ -50,8 +50,24 @@ def make_train_step(model, tx: optax.GradientTransformation,
     x, y: (accum, B_global, T) int32 — the whole logical batch for one
     optimizer step; axis 0 is scanned (grad accumulation, reference
     single-gpu/train.py:338-345).
+
+    Overlap interaction (ops/collective_matmul.py): the resolved OVERLAP
+    mode is published for the trace so the model's matmul call sites can
+    ring their ZeRO-3 param gathers. With grad accumulation (accum > 1)
+    the per-layer gathers are instead HOISTED out of the micro-batch scan:
+    params are constrained replicated ONCE before the scan (one all-gather
+    per optimizer step instead of one per accumulation micro-step — the
+    standard FSDP no-reshard-between-microbatches trade: full fp32 params
+    resident for the step), gradients still reduce-scatter per micro-step
+    through the sharded-accumulator constraint, and the in-model rings
+    stand down via context.gathers_hoisted.
     """
+    from distributed_pytorch_tpu.ops import collective_matmul as cm
     recipe = train_cfg.parallelism
+    overlap_mode = cm.resolve_mode(getattr(train_cfg, "overlap", "auto"))
+    overlap_on = (overlap_mode == "on" and mesh is not None
+                  and recipe in cm._ZERO3_RECIPES
+                  and mesh.shape.get("data", 1) > 1)
 
     def loss_fn(params, moe_state, x, y, dropout_rng):
         variables = {"params": params}
@@ -70,9 +86,12 @@ def make_train_step(model, tx: optax.GradientTransformation,
         return loss, new_moe
 
     def train_step(state: TrainState, x: jnp.ndarray, y: jnp.ndarray):
-        # publish the mesh for the duration of TRACING: sequence-parallel
-        # attention (ops/ring_attention.py) reads it to shard_map over 'seq'
-        with context.use_mesh(mesh):
+        # publish the mesh (+ overlap mode) for the duration of TRACING:
+        # sequence-parallel attention (ops/ring_attention.py) reads the
+        # mesh to shard_map over 'seq'; the collective-matmul dispatcher
+        # reads (mode, recipe) to decide whether to ring param gathers
+        with context.use_mesh(mesh), \
+                context.use_overlap(overlap_mode, recipe):
             return _train_step_body(state, x, y)
 
     def _train_step_body(state: TrainState, x: jnp.ndarray, y: jnp.ndarray):
@@ -90,6 +109,21 @@ def make_train_step(model, tx: optax.GradientTransformation,
             def grad_constraint(g):
                 return g
 
+        # gather hoisting (see make_train_step docstring): with accum > 1,
+        # one param all-gather per optimizer step beats one per micro-step;
+        # with_sharding_constraint-to-replicated is a numeric identity, so
+        # parity with the oracle is untouched. Grads are taken w.r.t. the
+        # gathered tree (same values) and reduce-scatter per micro-step
+        # through grad_constraint, preserving ZeRO grad sharding.
+        hoist = overlap_on and accum > 1
+        if hoist:
+            repl = NamedSharding(mesh, P())
+            loss_params = jax.tree_util.tree_map(
+                lambda p: jax.lax.with_sharding_constraint(p, repl),
+                state.params)
+        else:
+            loss_params = state.params
+
         zeros = grad_constraint(jax.tree_util.tree_map(
             lambda p: jnp.zeros(p.shape, jnp.float32), state.params))
 
@@ -98,14 +132,15 @@ def make_train_step(model, tx: optax.GradientTransformation,
             xi, yi, idx = xs
             rng = jax.random.fold_in(base_rng, idx)
             (loss, new_moe), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(state.params, moe_state, xi, yi, rng)
+                loss_fn, has_aux=True)(loss_params, moe_state, xi, yi, rng)
             g_acc = grad_constraint(
                 jax.tree_util.tree_map(jnp.add, g_acc, grads))
             return (g_acc, new_moe), loss
 
-        (g_acc, new_moe), losses = jax.lax.scan(
-            micro_step, (zeros, state.moe_state),
-            (x, y, jnp.arange(accum)))
+        with context.hoisted_gathers(hoist):
+            (g_acc, new_moe), losses = jax.lax.scan(
+                micro_step, (zeros, state.moe_state),
+                (x, y, jnp.arange(accum)))
         grads = jax.tree_util.tree_map(lambda g: g / accum, g_acc)
 
         updates, new_opt = tx.update(grads, state.opt_state, state.params)

@@ -27,22 +27,45 @@ from distributed_pytorch_tpu.train.step import make_train_step
 
 def time_variant(batch: int, attn_impl: str, act_recomp: bool,
                  loss_impl: str, iters: int) -> dict | None:
-    from distributed_pytorch_tpu.config import flagship_gpt124m
-    model_cfg = flagship_gpt124m(act_recomp=act_recomp,
-                                 act_recomp_policy="attn",
-                                 loss_impl=loss_impl)
+    import os as _os
+
+    from distributed_pytorch_tpu.config import PRESETS
+    # per-subprocess env knobs (like FLASH_BLOCK_*): SWEEP_PRESET picks the
+    # ladder rung, SWEEP_RECIPE the parallelism (OVERLAP/OVERLAP_RING are
+    # read by ops/collective_matmul.py directly)
+    preset = _os.environ.get("SWEEP_PRESET", "gpt2_124m")
+    recipe = _os.environ.get("SWEEP_RECIPE", "single")
+    model_cfg = PRESETS[preset](act_recomp=act_recomp,
+                                act_recomp_policy="attn",
+                                loss_impl=loss_impl)
+    n_dev = len(jax.devices()) if recipe != "single" else 1
     train_cfg = TrainConfig(
-        dataset="synthetic", total_batch_size=batch * 1024,
-        batch_size=batch, max_iters=iters, parallelism="single",
+        dataset="synthetic", total_batch_size=batch * n_dev * 1024,
+        batch_size=batch, max_iters=iters, parallelism=recipe,
         attn_impl=attn_impl, eval=False, save_model=False, save_stats=False,
         compute_dtype="bfloat16")
 
     try:
-        model, tx, state, state_sh = create_train_state(model_cfg, train_cfg)
-        step = make_train_step(model, tx, model_cfg, train_cfg, None, None)
+        mesh = None
+        if recipe != "single":
+            from distributed_pytorch_tpu.parallel.mesh import mesh_for
+            mesh = mesh_for(recipe)
+        model, tx, state, state_sh = create_train_state(model_cfg,
+                                                        train_cfg, mesh)
+        step = make_train_step(model, tx, model_cfg, train_cfg, mesh,
+                               state_sh)
         rng = jax.random.PRNGKey(0)
-        x = jax.random.randint(rng, (1, batch, 1024), 0, 50304, jnp.int32)
-        y = jax.random.randint(rng, (1, batch, 1024), 0, 50304, jnp.int32)
+        x = jax.random.randint(rng, (1, batch * n_dev, 1024), 0, 50304,
+                               jnp.int32)
+        y = jax.random.randint(rng, (1, batch * n_dev, 1024), 0, 50304,
+                               jnp.int32)
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from distributed_pytorch_tpu.parallel import sharding as shd
+            bsh = NamedSharding(mesh, shd.batch_pspec(recipe, mesh,
+                                                      leading_accum=True))
+            x = jax.device_put(x, bsh)
+            y = jax.device_put(y, bsh)
         state, m = step(state, x, y)       # compile + warmup
         jax.device_get(m)
         # Sync via device_get of the step metrics, exactly like the trainer's
@@ -71,17 +94,21 @@ def time_variant(batch: int, attn_impl: str, act_recomp: bool,
         return None
 
     dt = float(np.median(times))
-    tokens = batch * 1024
+    tokens = batch * n_dev * 1024
     flops = M.step_flops(model_cfg, tokens, 1024)
     peak = M.peak_flops_per_chip()
-    mfu = flops / dt / peak if peak else float("nan")
+    mfu = flops / dt / (peak * n_dev) if peak else float("nan")
     hbm = M.device_memory_gb()
+    tag = "" if (preset, recipe) == ("gpt2_124m", "single") \
+        else f" [{preset}/{recipe}]"
     print(f"batch={batch:3d} attn={attn_impl:6s} remat={act_recomp!s:5s} "
           f"loss={loss_impl:9s} | {dt * 1e3:7.1f} ms | "
-          f"{tokens / dt:9.0f} tok/s | mfu {mfu:6.2%} | hbm {hbm or 0:5.2f}GB",
+          f"{tokens / dt:9.0f} tok/s | mfu {mfu:6.2%} | "
+          f"hbm {hbm or 0:5.2f}GB{tag}",
           flush=True)
     return {"batch": batch, "attn": attn_impl, "remat": act_recomp,
-            "loss": loss_impl, "ms": dt * 1e3, "mfu": mfu}
+            "loss": loss_impl, "ms": dt * 1e3, "mfu": mfu,
+            "preset": preset, "recipe": recipe}
 
 
 def main():
@@ -159,6 +186,42 @@ def main():
                                           "CE_BLOCK_V": "4096"}),
             (16, "pallas", False, "pallas", {"FLASH_BLOCK_Q": "256",
                                              "FLASH_BLOCK_K": "512"}),
+        ]
+    elif args.variants == "overlap":
+        # collective-matmul A/B on the real sharded train step
+        # (ops/collective_matmul.py): GSPMD baseline vs uni/bidir rings vs
+        # hoisted gathers is decided by OVERLAP/OVERLAP_RING env, per
+        # subprocess. fsdp on every available chip.
+        grid = [
+            (8, "xla", False, "fused", {"SWEEP_RECIPE": "fsdp"}),
+            (8, "xla", False, "fused", {"SWEEP_RECIPE": "fsdp",
+                                        "OVERLAP": "on"}),
+            (8, "xla", False, "fused", {"SWEEP_RECIPE": "fsdp",
+                                        "OVERLAP": "on",
+                                        "OVERLAP_RING": "uni"}),
+            (16, "pallas", False, "fused", {"SWEEP_RECIPE": "fsdp"}),
+            (16, "pallas", False, "fused", {"SWEEP_RECIPE": "fsdp",
+                                            "OVERLAP": "on"}),
+        ]
+    elif args.variants == "ladder":
+        # the 350M-1.5B rungs (BASELINE.json): batch/remat per the static
+        # HBM plan printed by --dryrun; OVERLAP on/off legs for each rung
+        grid = [
+            (16, "xla", True, "fused", {"SWEEP_PRESET": "gpt2_350m",
+                                        "SWEEP_RECIPE": "zero2"}),
+            (16, "xla", True, "fused", {"SWEEP_PRESET": "gpt2_350m",
+                                        "SWEEP_RECIPE": "zero2",
+                                        "OVERLAP": "on"}),
+            (8, "xla", True, "fused", {"SWEEP_PRESET": "gpt2_774m",
+                                       "SWEEP_RECIPE": "fsdp"}),
+            (8, "xla", True, "fused", {"SWEEP_PRESET": "gpt2_774m",
+                                       "SWEEP_RECIPE": "fsdp",
+                                       "OVERLAP": "on"}),
+            (2, "xla", True, "fused", {"SWEEP_PRESET": "gpt2_1p5b",
+                                       "SWEEP_RECIPE": "fsdp"}),
+            (2, "xla", True, "fused", {"SWEEP_PRESET": "gpt2_1p5b",
+                                       "SWEEP_RECIPE": "fsdp",
+                                       "OVERLAP": "on"}),
         ]
     else:
         grid = list(itertools.product((16, 32, 64), ("xla", "pallas"),

@@ -126,9 +126,10 @@ def test_multihost_env_detection(env, expected):
 
 
 def test_initialize_called_on_pod_env(monkeypatch):
+    from distributed_pytorch_tpu import compat
     calls = []
     monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "t0,t1")
-    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False)
+    monkeypatch.setattr(compat, "distributed_is_initialized", lambda: False)
     monkeypatch.setattr(jax.distributed, "initialize",
                         lambda *a, **k: calls.append(1))
     maybe_initialize_distributed()
@@ -136,9 +137,10 @@ def test_initialize_called_on_pod_env(monkeypatch):
 
 
 def test_initialize_skipped_when_already_up(monkeypatch):
+    from distributed_pytorch_tpu import compat
     calls = []
     monkeypatch.setenv("JAX_NUM_PROCESSES", "2")
-    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: True)
+    monkeypatch.setattr(compat, "distributed_is_initialized", lambda: True)
     monkeypatch.setattr(jax.distributed, "initialize",
                         lambda *a, **k: calls.append(1))
     maybe_initialize_distributed()
@@ -159,9 +161,10 @@ def test_initialize_failure_is_fatal(monkeypatch):
     """A detected multi-process env with a failing initialize must abort,
     not silently train disconnected (the reference's torchrun likewise
     rendezvouses or dies, multi-gpu/ddp/train.py:19-25)."""
+    from distributed_pytorch_tpu import compat
     monkeypatch.setenv("JAX_NUM_PROCESSES", "2")
     monkeypatch.setenv("JAX_PROCESS_ID", "not-an-int")
-    monkeypatch.setattr(jax.distributed, "is_initialized", lambda: False)
+    monkeypatch.setattr(compat, "distributed_is_initialized", lambda: False)
     with pytest.raises(RuntimeError, match="disconnected"):
         maybe_initialize_distributed()
 

@@ -233,20 +233,20 @@ def test_scatter_dispatch_buffers_sharded_over_data():
     from distributed_pytorch_tpu.parallel.mesh import build_mesh, resolve_plan
 
     mesh = build_mesh(resolve_plan("ep", 8, ep_size=2))  # data=4, expert=2
-    seen = {}
 
-    def probe(t):
-        out = _expert_constraint(t)
-        jax.debug.inspect_array_sharding(
-            out, callback=lambda s: seen.setdefault("spec", s.spec))
-        return out * 1.0
-
+    # return the constrained array itself: its committed sharding IS the
+    # constraint GSPMD honored (jax.debug.inspect_array_sharding's compile-
+    # time callback crashes with an INTERNAL error on jax 0.4.x, so the
+    # assertion moved from compile-time inspection to the result array)
     with context.use_mesh(mesh):
         # E=4 (divisible by ep=2), capacity=8 (divisible by dp=4), C=16
-        jax.jit(probe)(jnp.zeros((4, 8, 16)))
-    spec = seen["spec"]
+        out = jax.jit(_expert_constraint)(jnp.zeros((4, 8, 16)))
+    spec = out.sharding.spec
+    spec = tuple(spec) + (None,) * (3 - len(tuple(spec)))
     assert spec[0] == "expert", spec
     assert spec[1] == "data", spec
+    shard = out.addressable_shards[0].data
+    assert shard.shape == (2, 2, 16), shard.shape  # E/ep x cap/dp x C
 
 
 def test_scatter_capacity_rounds_to_data_axis():

@@ -20,10 +20,11 @@ import pytest
 # the experiment definition cannot drift between them.
 _WORKER = textwrap.dedent("""
     import os, sys, json
+    sys.path.insert(0, __REPO__)
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", int(os.environ["MH_DEVICES"]))
-    sys.path.insert(0, __REPO__)
+    from distributed_pytorch_tpu import compat
+    compat.request_cpu_devices(int(os.environ["MH_DEVICES"]))
     from distributed_pytorch_tpu.config import LLMConfig, TrainConfig
     from distributed_pytorch_tpu.train.loop import train
 

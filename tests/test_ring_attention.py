@@ -293,12 +293,14 @@ def test_zigzag_matches_contiguous_ring():
     scale = 1.0 / D ** 0.5
 
     import functools
-    ring = jax.shard_map(
+
+    from distributed_pytorch_tpu import compat
+    ring = compat.shard_map(
         functools.partial(ring_attention_local, scale=scale, sp=sp),
         mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)(q, k, v)
 
     perm, inv = zigzag_permutation(T, sp)
-    zz = jax.shard_map(
+    zz = compat.shard_map(
         functools.partial(zigzag_ring_attention_local, scale=scale, sp=sp),
         mesh=mesh, in_specs=(spec,) * 3,
         out_specs=spec)(q[:, perm], k[:, perm], v[:, perm])[:, inv]

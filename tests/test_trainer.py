@@ -145,8 +145,11 @@ def test_moe_state_updates_during_training():
                      parallelism="single")
     model, tx, state, _ = create_train_state(mc, tc, None)
     step = make_train_step(model, tx, mc, tc, None, None)
-    bias0 = [np.asarray(b) for b in
-             jax.tree_util.tree_leaves(state.moe_state)]  # copy: state is donated
+    # np.array (never asarray): on CPU jax, asarray is a zero-copy VIEW
+    # into the device buffer, which the donated step reuses -- the
+    # 'before' snapshot would silently track the updated values
+    bias0 = [np.array(b) for b in
+             jax.tree_util.tree_leaves(state.moe_state)]
     assert bias0, "moe_state should be non-empty for aux_free MoE"
     x, y = _fake_batch(mc, 1, 2, seed=1)
     state, _ = step(state, x, y)
@@ -168,7 +171,8 @@ def test_moe_train_step_under_act_recomp(policy):
                      parallelism="single")
     model, tx, state, _ = create_train_state(mc, tc, None)
     step = make_train_step(model, tx, mc, tc, None, None)
-    bias0 = [np.asarray(b) for b in
+    # np.array: a zero-copy asarray view would alias the donated buffer
+    bias0 = [np.array(b) for b in
              jax.tree_util.tree_leaves(state.moe_state)]
     x, y = _fake_batch(mc, 1, 2, seed=1)
     state, m = step(state, x, y)

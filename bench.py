@@ -170,6 +170,18 @@ def run_bench(platform: str, only_recipe: str | None = None) -> dict:
                     act_recomp_policy=mplan.act_recomp_policy)
             per_chip = int(os.environ.get("BENCH_BATCH",
                                           str(mplan.micro_batch)))
+        elif os.environ.get("BENCH_MOE"):
+            # MoE A/B leg (MOE_IMPL=dense|scatter|grouped): the flagship
+            # backbone with a DeepSeekMoE FFN sized so the ACTIVE params
+            # stay 124M-class (n_act incl. shared; n_exp x up_dim=1024
+            # experts). The three dispatch impls run the same model —
+            # only the dispatch (and its dropped tokens / padded FLOPs)
+            # differs, so the legs isolate dispatch cost.
+            model_cfg = flagship_gpt124m(
+                moe=True, n_exp=8, n_shared=1, n_act=3, up_dim=1024,
+                moe_impl=os.environ.get("MOE_IMPL", "grouped"),
+                loss_impl=os.environ.get("BENCH_LOSS", "fused"))
+            per_chip = int(os.environ.get("BENCH_BATCH", "16"))
         else:
             model_cfg = flagship_gpt124m(
                 act_recomp=os.environ.get("BENCH_REMAT", "0") == "1",
@@ -195,16 +207,29 @@ def run_bench(platform: str, only_recipe: str | None = None) -> dict:
             total_batch_size=per_chip * n_dev * model_cfg.block_size,
             batch_size=per_chip,
             max_iters=iters, parallelism=recipe, attn_impl=attn_impl,
+            moe_impl=model_cfg.moe_impl,
+            ep_size=int(os.environ.get("BENCH_EP", "1")),
             # sync every 4 steps: host round-trips overlap device compute
             # (train/loop.py sync discipline), like a real pod run would
             log_interval=4, eval=False, save_model=False, save_stats=False,
             compute_dtype="bfloat16")
         stats = train(model_cfg, train_cfg,
                       log=lambda s: print(f"[{recipe}] {s}", file=sys.stderr))
-        return {"tokens_per_sec_per_chip":
-                    round(stats["median_tokens_per_sec"] / n_dev, 1),
-                "mfu": stats.get("median_mfu"),
-                "peak_hbm_gb": stats.get("peak_hbm_gb")}
+        out = {"tokens_per_sec_per_chip":
+                   round(stats["median_tokens_per_sec"] / n_dev, 1),
+               "mfu": stats.get("median_mfu"),
+               "peak_hbm_gb": stats.get("peak_hbm_gb")}
+        if model_cfg.moe:
+            # dropped assignments (scatter's silent GShard drops; 0 for
+            # dense/grouped) + how much the dispatch overspends FLOPs —
+            # the pair the MOE_IMPL A/B decides on
+            from distributed_pytorch_tpu.train.metrics import \
+                moe_overcompute_factor
+            out["moe_dropped_frac"] = stats.get("final_moe_dropped_frac")
+            out["moe_impl"] = model_cfg.moe_impl
+            out["moe_overcompute"] = round(
+                moe_overcompute_factor(model_cfg), 3)
+        return out
 
     if n_dev > 1:
         # BASELINE.md asks for the FSDP-vs-DDP MFU comparison; fsdp is the
@@ -221,7 +246,9 @@ def run_bench(platform: str, only_recipe: str | None = None) -> dict:
              "device": jax.devices()[0].device_kind,
              "per_chip_batch": per_chip,
              "overlap": os.environ.get("OVERLAP", "auto"),
-             "preset": os.environ.get("BENCH_PRESET", "") or "gpt2_124m",
+             "preset": os.environ.get("BENCH_PRESET", "")
+                       or ("gpt2_124m_moe" if os.environ.get("BENCH_MOE")
+                           else "gpt2_124m"),
              "recipes": {k: {kk: (round(vv, 4) if isinstance(vv, float) else vv)
                              for kk, vv in v.items()}
                          for k, v in results.items()}}
@@ -283,7 +310,8 @@ def main() -> None:
         if not (os.environ.get("BENCH_BATCH")
                 or os.environ.get("BENCH_REMAT")
                 or os.environ.get("BENCH_LOSS")
-                or os.environ.get("BENCH_ATTN")):
+                or os.environ.get("BENCH_ATTN")
+                or os.environ.get("BENCH_MOE")):
             # No explicit config: measure the ambitious default (bigger
             # per-chip batch amortizes per-step overhead; attention-only
             # remat keeps it inside HBM) AND the conservative known-good
@@ -304,7 +332,17 @@ def main() -> None:
                     ("batch32_remat_xla",
                      {"BENCH_BATCH": "32", "BENCH_REMAT": "1",
                       "BENCH_ATTN": "xla"}),
-                    ("batch16", None)]
+                    ("batch16", None),
+                    # MOE_IMPL A/B (round 7): same MoE model, three
+                    # dispatches — dense (E/k x padded FLOPs), scatter
+                    # (capacity-padded, DROPS tokens), grouped (the
+                    # dropless Pallas ragged kernel). These legs decide
+                    # the dispatch default for MoE-at-scale.
+                    ("moe_dense", {"BENCH_MOE": "1", "MOE_IMPL": "dense"}),
+                    ("moe_scatter", {"BENCH_MOE": "1",
+                                     "MOE_IMPL": "scatter"}),
+                    ("moe_grouped", {"BENCH_MOE": "1",
+                                     "MOE_IMPL": "grouped"})]
             if _multi_chip_probe():
                 # overlap A/B (collective-matmul rings vs GSPMD default)
                 # and the config ladder (BASELINE.json rungs; the HBM
@@ -324,6 +362,17 @@ def main() -> None:
                     ("774m_fsdp_overlap", {"BENCH_PRESET": "gpt2_774m",
                                            "BENCH_RECIPE": "fsdp",
                                            "OVERLAP": "on"}),
+                    # expert-parallel MOE_IMPL A/B: scatter's GSPMD
+                    # all-to-alls around padded matmuls vs the packed
+                    # grouped kernel inside shard_map over 'expert'
+                    ("moe_scatter_ep", {"BENCH_MOE": "1",
+                                        "MOE_IMPL": "scatter",
+                                        "BENCH_RECIPE": "ep",
+                                        "BENCH_EP": "2"}),
+                    ("moe_grouped_ep", {"BENCH_MOE": "1",
+                                        "MOE_IMPL": "grouped",
+                                        "BENCH_RECIPE": "ep",
+                                        "BENCH_EP": "2"}),
                 ]
             for name, env in legs:
                 # 900s/leg: a healthy leg is ~3 min incl. compile; the cap

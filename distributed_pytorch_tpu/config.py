@@ -72,7 +72,10 @@ class LLMConfig:
     # token (semantics oracle, no token dropping; fine for few experts);
     # 'scatter' is the capacity-bounded sort-based dispatch (EP-shardable,
     # O(active) FLOPs — the reference's O(active) Python loop equivalent,
-    # single-gpu/model.py:489-506, made static-shape for XLA)
+    # single-gpu/model.py:489-506, made static-shape for XLA — but drops
+    # assignments past capacity); 'grouped' is the dropless Pallas ragged
+    # grouped-matmul dispatch (ops/grouped_matmul.py — O(active) FLOPs AND
+    # zero drops; falls back to 'dense' where the kernel can't run)
     moe_impl: str = "dense"
     capacity_factor: float = 2.0  # scatter: per-expert slots = cf * N*k/E
 
@@ -139,7 +142,7 @@ class LLMConfig:
             assert self.n_exp > self.n_shared
             assert self.n_act <= self.n_exp, \
                 "n_act (which includes shared experts) cannot exceed n_exp"
-        assert self.moe_impl in ("dense", "scatter"), \
+        assert self.moe_impl in ("dense", "scatter", "grouped"), \
             f"unknown moe_impl {self.moe_impl!r}"
         assert self.capacity_factor > 0
         assert self.act_recomp_policy in ("block", "attn"), \
@@ -273,7 +276,7 @@ class TrainConfig:
     # the contiguous-layout ring, 'ulysses' the all-to-all head<->sequence
     # variant (ops/ring_attention.py)
     attn_impl: str = "auto"  # auto | xla | pallas | naive | ring | zigzag | ulysses
-    moe_impl: str = "dense"          # 'dense' | 'scatter'
+    moe_impl: str = "dense"          # 'dense' | 'scatter' | 'grouped'
     # collective-matmul overlap for the ZeRO-3 family
     # (ops/collective_matmul.py): 'on' fuses param all-gathers / grad
     # reduce-scatters into ppermute rings overlapped with the matmuls;
@@ -289,7 +292,7 @@ class TrainConfig:
     def __post_init__(self):
         assert self.parallelism in PARALLELISM_RECIPES, \
             f"unknown parallelism recipe {self.parallelism!r}"
-        assert self.moe_impl in ("dense", "scatter"), \
+        assert self.moe_impl in ("dense", "scatter", "grouped"), \
             f"unknown moe_impl {self.moe_impl!r}"
         assert self.attn_impl in ("auto", "xla", "pallas", "naive", "ring",
                                   "zigzag", "ulysses"), \

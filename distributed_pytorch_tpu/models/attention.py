@@ -38,7 +38,7 @@ TPU-first design notes (intentional divergences, documented per SURVEY §7):
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Any, Optional
 
 import flax.linen as nn
 import jax
@@ -53,10 +53,34 @@ Cache = dict[str, jnp.ndarray]
 _DENSE_INIT = nn.initializers.normal(stddev=0.02)
 
 
-def _dense(features: int, use_bias: bool, dtype, name: str) -> nn.Dense:
-    return nn.Dense(features, use_bias=use_bias, dtype=dtype,
-                    param_dtype=jnp.float32, kernel_init=_DENSE_INIT,
-                    bias_init=nn.initializers.zeros, name=name)
+class _OverlapDense(nn.Module):
+    """nn.Dense twin (identical param tree — kernel/bias under this
+    module's name — init, and dtype semantics) whose matmul is offered to
+    the collective-matmul dispatcher (ops/collective_matmul.py) first.
+
+    Used for the fused qkv and attention out-projection: under an active
+    OVERLAP=on ZeRO-3 step their param all-gathers run as ppermute rings
+    fused with the matmul (closing the round-6 ROADMAP gap — the MLP and
+    lm-head already ring; these two call sites were the last GSPMD-default
+    gathers). Everywhere else the dispatcher declines and the plain `@`
+    below is bit-identical to nn.Dense."""
+
+    features: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param("kernel", _DENSE_INIT,
+                            (x.shape[-1], self.features), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (self.features,), jnp.float32)
+        kd = kernel.astype(self.dtype)
+        from distributed_pytorch_tpu.ops.collective_matmul import (
+            maybe_overlap_matmul)
+        y = maybe_overlap_matmul(x, kd, names=(self.name, "kernel"))
+        if y is None:
+            y = x @ kd
+        return y + bias.astype(self.dtype)
 
 
 def _update_cache(cache_arr: jnp.ndarray, new: jnp.ndarray, pos) -> jnp.ndarray:
@@ -84,7 +108,7 @@ class GQA(nn.Module):
         B, T, C = x.shape
         nh, nkvh, hs = cfg.n_head, cfg.n_kv_heads, cfg.head_size
 
-        qkv = _dense(C + 2 * nkvh * hs, True, x.dtype, "c_attn")(x)
+        qkv = _OverlapDense(C + 2 * nkvh * hs, x.dtype, name="c_attn")(x)
         q, k, v = jnp.split(qkv, [C, C + nkvh * hs], axis=-1)
         q = q.reshape(B, T, nh, hs)
         k = k.reshape(B, T, nkvh, hs)
@@ -112,7 +136,7 @@ class GQA(nn.Module):
                  dropout_rng=drop_rng, impl=self.attn_impl,
                  decode=cache is not None)
         y = y.reshape(B, T, C)
-        y = _dense(C, True, x.dtype, "c_proj")(y)
+        y = _OverlapDense(C, x.dtype, name="c_proj")(y)
         y = nn.Dropout(cfg.dropout, deterministic=deterministic)(y)
         return y, new_cache
 

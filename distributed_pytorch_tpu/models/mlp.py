@@ -24,7 +24,7 @@ TPU-first design (SURVEY §7 hard part (a)):
   leaf per projection, shardable over an 'expert' mesh axis for expert
   parallelism (capability absent from the reference, whose dispatch is a
   data-dependent Python loop over experts, model.py:489-506).
-* Dispatch is static-shape, two modes (LLMConfig.moe_impl):
+* Dispatch is static-shape, three modes (LLMConfig.moe_impl):
   - 'dense' evaluates every routed expert on every token and combines with
     a (tokens, n_routed) gate matrix that is zero outside the top-k —
     bitwise-equal semantics to the reference loop (no capacity limit, no
@@ -33,13 +33,24 @@ TPU-first design (SURVEY §7 hard part (a)):
   - 'scatter' is the capacity-bounded sort-based dispatch: assignments are
     stable-sorted by expert, each expert takes its first
     `capacity = ceil(capacity_factor * N*k/E)` tokens into an (E, cap, C)
-    buffer (later tokens are DROPPED, GShard-style position priority),
-    expert FFNs run batched over the leading expert axis, and results
-    scatter-add back weighted by their gates. O(active) FLOPs like the
-    reference's Python loop (model.py:489-506) but static-shape for XLA;
-    the (E, cap, C) buffers carry a 'expert'-axis sharding constraint so
-    under the ep recipe GSPMD turns dispatch/return into all-to-alls over
-    the expert mesh axis.
+    buffer (later tokens are DROPPED, GShard-style position priority —
+    the dropped fraction is surfaced as the `dropped_frac` moe_state
+    metric / `moe_dropped_frac` train metric), expert FFNs run batched
+    over the leading expert axis, and results scatter-add back weighted
+    by their gates. O(active) FLOPs like the reference's Python loop
+    (model.py:489-506) but static-shape for XLA; the (E, cap, C) buffers
+    carry a 'expert'-axis sharding constraint so under the ep recipe
+    GSPMD turns dispatch/return into all-to-alls over the expert mesh
+    axis.
+  - 'grouped' is the dropless Pallas ragged grouped-matmul dispatch
+    (ops/grouped_matmul.py, MegaBlocks arXiv:2211.15841 flavor): tokens
+    stay packed in one expert-sorted buffer (no capacity padding, zero
+    dropped assignments), every expert's x_e @ W_e streams weight tiles
+    per group, the shared experts ride the same kernel as always-on
+    groups, and the combine gates are applied at the kernel's output
+    write. Falls back to 'dense' — identical semantics, more FLOPs —
+    where the kernel can't run (pipeline-vmapped blocks, live 'model' or
+    'seq' mesh axes, non-lane-aligned widths; see grouped_usable).
 * The aux-free bias is cross-batch mutable state; it lives in the 'moe_state'
   variable collection, carried in the train state. Under pjit the batch is
   global, so load statistics (and hence the bias update) are computed over
@@ -251,15 +262,23 @@ class MoE(nn.Module):
         x_flat = x.reshape(-1, C)  # (N, C)
         n_tokens = x_flat.shape[0]
 
+        use_grouped = False
+        if cfg.moe_impl == "grouped":
+            from distributed_pytorch_tpu.ops.grouped_matmul import \
+                grouped_usable
+            use_grouped = grouped_usable(cfg, B, dt)
+
         # ---------------- shared expert path (reference :440-445) ----------
         def one_expert(wf, wp):
             return mlp_apply(x_flat, wf.astype(dt), wp.astype(dt),
                              cfg.non_linearity)
 
-        if n_shared > 0:
+        if n_shared > 0 and not use_grouped:
             shared_out = jax.vmap(one_expert)(
                 experts_fc[:n_shared], experts_proj[:n_shared]).sum(axis=0)
         else:
+            # grouped: shared experts ride the grouped kernel as always-on
+            # groups (one group per shared expert, every token, gate 1.0)
             shared_out = jnp.zeros_like(x_flat)
 
         # ---------------- router (fp32 for numerics) -----------------------
@@ -295,6 +314,7 @@ class MoE(nn.Module):
             aux_loss = cfg.coeff * n_routed * jnp.sum(pi * fi)
 
         # ---------------- routed dispatch (see module docstring) -----------
+        dropped_frac = jnp.float32(0.0)
         if cfg.moe_impl == "scatter":
             capacity = max(k, math.ceil(
                 cfg.capacity_factor * n_tokens * k / n_routed))
@@ -310,6 +330,22 @@ class MoE(nn.Module):
                 x_flat, topk_idx, topk_gates,
                 experts_fc[n_shared:], experts_proj[n_shared:],
                 non_linearity=cfg.non_linearity, capacity=capacity)
+            # assignments past an expert's capacity are silently dropped
+            # (GShard position priority) — surface the fraction so the
+            # drop is visible in train logs / bench JSON. 'grouped' and
+            # 'dense' are dropless by construction and report 0.
+            load = jnp.zeros((n_routed,), jnp.int32).at[
+                topk_idx.reshape(-1)].add(1)
+            dropped_frac = (jnp.maximum(load - capacity, 0).sum()
+                            / jnp.float32(n_tokens * k))
+        elif use_grouped:
+            from distributed_pytorch_tpu.ops.grouped_matmul import \
+                grouped_dispatch
+            # includes the shared experts as always-on groups (shared_out
+            # above is zeros on this path)
+            routed_out = grouped_dispatch(
+                x_flat, topk_idx, topk_gates, experts_fc, experts_proj,
+                non_linearity=cfg.non_linearity, n_shared=n_shared)
         else:
             # combine[t, e] = gate weight of expert e for token t (0 if
             # unrouted)
@@ -318,6 +354,16 @@ class MoE(nn.Module):
                 experts_fc[n_shared:], experts_proj[n_shared:])  # (E, N, C)
             routed_out = jnp.einsum("enc,ne->nc", all_routed,
                                     combine.astype(dt))
+
+        # cross-batch metric state, carried like the aux-free bias; only
+        # real microbatches write (sw=0 pipeline bubble slots hold zero
+        # tokens whose deterministic routing would fake a drop rate)
+        drop_var = self.variable("moe_state", "dropped_frac",
+                                 lambda: jnp.float32(0.0))
+        if not deterministic and self.is_mutable_collection("moe_state"):
+            sw_arr = jnp.asarray(sw, jnp.float32)
+            drop_var.value = jnp.where(sw_arr > 0, dropped_frac,
+                                       drop_var.value)
 
         y = (shared_out + routed_out).reshape(B, T, C)
         return y, aux_loss.astype(jnp.float32) * sw

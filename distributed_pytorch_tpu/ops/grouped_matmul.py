@@ -1,0 +1,472 @@
+"""Pallas TPU ragged grouped matmul: dropless MoE expert dispatch.
+
+Why: the 'scatter' dispatch (models/mlp.py) is XLA-legal but pays twice —
+it materializes (E, capacity, C) gather/scatter buffers in HBM on BOTH
+sides of the expert FFNs, and it silently DROPS routed assignments past
+`capacity` (GShard position priority). GSPMD lowers the ep recipe's
+dispatch/return as all-to-alls but leaves the per-expert matmuls padded
+and dense (arXiv:2105.04663 §3.3) — exactly the waste a ragged grouped
+kernel removes (MegaBlocks, arXiv:2211.15841).
+
+Layout: routed assignments are stable-sorted by expert into ONE packed
+buffer whose groups are padded only to the next token-tile boundary
+(bm rows, not `capacity`), so the buffer holds every assignment — dropless
+by construction. A scalar-prefetch array maps each bm-row tile to its
+expert, so the kernel streams exactly one expert's weight tile per grid
+step and empty experts get ZERO grid steps (they own no tiles). The
+combine weights (router gates) are applied at the second matmul's output
+write, so the scatter-add back to (N, C) is the only HBM round trip on
+the return path.
+
+Kernels (all f32-accumulated; operands stay in the input dtype so the MXU
+runs at full rate; structure mirrors ops/fused_ce.py):
+
+* forward  — grid (token_tiles, n_tiles): one (bm, K) x tile and the
+  owning expert's (K, bn) weight tile are resident; output written once,
+  optionally scaled per row by the combine gate.
+* backward dx (token-major) — grid (token_tiles, k_tiles):
+  dx = (dy * gate) @ W_e^T, streamed over K tiles of the same expert tile
+  the forward read.
+* backward dW (group-major) — grid (k_tiles, n_tiles, token_tiles), token
+  tiles innermost: consecutive tiles of one expert hit the SAME output
+  block, which stays resident in VMEM and accumulates
+  dW_e += x_tile^T @ (dy_tile * gate); the block flushes when the group
+  changes. Experts that own no tiles are never visited — their dW is
+  masked to zero afterwards.
+
+Sharding: under a live mesh the dispatch runs inside shard_map over
+('data', 'expert') (specs from parallel/sharding.moe_dispatch_specs).
+Tokens ride in data-sharded (they already are — zero dispatch
+collectives); each expert shard packs ONLY the assignments routed to its
+local experts (non-local assignments keep their slot with gate 0, so they
+cost tile-rounding FLOPs but contribute nothing) and one psum over
+'expert' combines the partial outputs. This replaces the scatter path's
+all-to-all pair with a single combine-reduction: under XLA's static
+shapes a dropless all-to-all needs worst-case (every assignment to one
+shard) buffers, which is the replicated layout anyway — the psum costs
+the same bytes as the return all-to-all + gather it replaces and keeps
+the dropless guarantee.
+
+Shared experts reuse the same kernel as always-on groups: the dispatch
+prepends one group per shared expert containing every token with gate
+1.0, so shared + routed experts stream through one packed kernel pair
+and one combine scatter-add.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from distributed_pytorch_tpu import compat
+from distributed_pytorch_tpu.parallel import context
+
+DEFAULT_BLOCK_M = int(os.environ.get("GMM_BLOCK_M", "128"))   # token rows
+DEFAULT_BLOCK_N = int(os.environ.get("GMM_BLOCK_N", "512"))   # out features
+DEFAULT_BLOCK_K = int(os.environ.get("GMM_BLOCK_K", "512"))   # contraction
+
+
+def _pick(n: int, preferred: int, step: int) -> int:
+    """Largest divisor of n that is <= preferred and a multiple of `step`;
+    n itself when no such divisor exists (tiny test dims)."""
+    b = min(preferred, n)
+    b -= b % step
+    while b > step and n % b != 0:
+        b -= step
+    return b if (b >= step and n % b == 0) else n
+
+
+def _dot(a, b):
+    return jax.lax.dot_general(a, b, (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _dot_nt(a, b):
+    """a @ b^T with f32 accumulation: (m, n), (k, n) -> (m, k)."""
+    return jax.lax.dot_general(a, b, (((1,), (1,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _dot_tn(a, b):
+    """a^T @ b with f32 accumulation: (m, k), (m, n) -> (k, n)."""
+    return jax.lax.dot_general(a, b, (((0,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# kernels
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel_scaled(g_ref, x_ref, w_ref, s_ref, o_ref):
+    del g_ref  # consumed by the index maps (weight-tile selection)
+    o = _dot(x_ref[:], w_ref[0]) * s_ref[:]
+    o_ref[:] = o.astype(o_ref.dtype)
+
+
+def _fwd_kernel(g_ref, x_ref, w_ref, o_ref):
+    del g_ref
+    o_ref[:] = _dot(x_ref[:], w_ref[0]).astype(o_ref.dtype)
+
+
+def _dx_kernel_scaled(g_ref, dy_ref, w_ref, s_ref, o_ref):
+    del g_ref
+    d = dy_ref[:].astype(jnp.float32) * s_ref[:]
+    o_ref[:] = _dot_nt(d.astype(dy_ref.dtype), w_ref[0]).astype(o_ref.dtype)
+
+
+def _dx_kernel(g_ref, dy_ref, w_ref, o_ref):
+    del g_ref
+    o_ref[:] = _dot_nt(dy_ref[:], w_ref[0]).astype(o_ref.dtype)
+
+
+def _dw_kernel(g_ref, f_ref, x_ref, dy_ref, *rest):
+    # rest = (s_ref?, dw_ref) — gate operand present only in scaled calls
+    if len(rest) == 2:
+        s_ref, dw_ref = rest
+        dy = dy_ref[:].astype(jnp.float32) * s_ref[:]
+    else:
+        (dw_ref,) = rest
+        dy = dy_ref[:]
+    del g_ref
+    i = pl.program_id(2)
+    part = _dot_tn(x_ref[:], dy.astype(x_ref.dtype))
+
+    @pl.when(f_ref[i] == 1)
+    def _():
+        dw_ref[:] = part[None].astype(dw_ref.dtype)
+
+    @pl.when(f_ref[i] == 0)
+    def _():
+        dw_ref[:] = dw_ref[:] + part[None].astype(dw_ref.dtype)
+
+
+def _fwd_call(x_pad, w, scales, tile_group, bm, interpret):
+    P, K = x_pad.shape
+    E, _, N = w.shape
+    num_tiles = P // bm
+    bn = _pick(N, DEFAULT_BLOCK_N, 8 if interpret else 128)
+    in_specs = [
+        pl.BlockSpec((bm, K), lambda i, j, g: (i, 0)),
+        pl.BlockSpec((1, K, bn), lambda i, j, g: (g[i], 0, j)),
+    ]
+    args = [tile_group, x_pad, w]
+    kern = _fwd_kernel
+    if scales is not None:
+        in_specs.append(pl.BlockSpec((bm, 1), lambda i, j, g: (i, 0)))
+        args.append(scales)
+        kern = _fwd_kernel_scaled
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(num_tiles, N // bn),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, g: (i, j)),
+    )
+    return pl.pallas_call(
+        kern, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((P, N), x_pad.dtype),
+        compiler_params=compat.tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel")),
+        interpret=interpret,
+    )(*args)
+
+
+def _dx_call(dy, w, scales, tile_group, bm, interpret):
+    P, N = dy.shape
+    E, K, _ = w.shape
+    num_tiles = P // bm
+    bk = _pick(K, DEFAULT_BLOCK_K, 8 if interpret else 128)
+    in_specs = [
+        pl.BlockSpec((bm, N), lambda i, k, g: (i, 0)),
+        pl.BlockSpec((1, bk, N), lambda i, k, g: (g[i], k, 0)),
+    ]
+    args = [tile_group, dy, w]
+    kern = _dx_kernel
+    if scales is not None:
+        in_specs.append(pl.BlockSpec((bm, 1), lambda i, k, g: (i, 0)))
+        args.append(scales)
+        kern = _dx_kernel_scaled
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(num_tiles, K // bk),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((bm, bk), lambda i, k, g: (i, k)),
+    )
+    return pl.pallas_call(
+        kern, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((P, K), dy.dtype),
+        compiler_params=compat.tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel")),
+        interpret=interpret,
+    )(*args)
+
+
+def _dw_call_impl(x_pad, dy, scales, tile_group, tile_first, n_experts,
+                  bm, interpret):
+    P, K = x_pad.shape
+    _, N = dy.shape
+    num_tiles = P // bm
+    step = 8 if interpret else 128
+    bk = _pick(K, DEFAULT_BLOCK_K, step)
+    bn = _pick(N, DEFAULT_BLOCK_N, step)
+    in_specs = [
+        pl.BlockSpec((bm, bk), lambda k, j, i, g, f: (i, k)),
+        pl.BlockSpec((bm, bn), lambda k, j, i, g, f: (i, j)),
+    ]
+    args = [tile_group, tile_first, x_pad, dy]
+    if scales is not None:
+        in_specs.append(pl.BlockSpec((bm, 1), lambda k, j, i, g, f: (i, 0)))
+        args.append(scales)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(K // bk, N // bn, num_tiles),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, bk, bn),
+                               lambda k, j, i, g, f: (g[i], k, j)),
+    )
+    return pl.pallas_call(
+        _dw_kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_experts, K, N), jnp.float32),
+        compiler_params=compat.tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(*args)
+
+
+# ---------------------------------------------------------------------------
+# custom VJP over the tile-aligned buffer
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
+def _gmm(x_pad, w, scales, tile_group, tile_first, counts, static):
+    """y[r] = (x_pad[r] @ w[expert_of_tile(r)]) * scales[r].
+
+    x_pad (P, K): tile-aligned expert-sorted rows (P = num_tiles * bm);
+    w (E, K, N); scales (P, 1) f32 or None; tile_group/tile_first
+    (num_tiles,) int32 metadata from _gmm_metadata; counts (E,) int32 real
+    rows per group (dW masking). static = (bm, interpret)."""
+    bm, interpret = static
+    return _fwd_call(x_pad, w, scales, tile_group, bm, interpret)
+
+
+def _gmm_fwd(x_pad, w, scales, tile_group, tile_first, counts, static):
+    y = _gmm(x_pad, w, scales, tile_group, tile_first, counts, static)
+    return y, (x_pad, w, scales, tile_group, tile_first, counts)
+
+
+def _gmm_bwd(static, res, dy):
+    bm, interpret = static
+    x_pad, w, scales, tile_group, tile_first, counts = res
+    ds = None
+    if scales is not None:
+        # gate cotangent needs the unscaled product; recompute it rather
+        # than storing a second (P, N) buffer from forward (same
+        # recompute-over-store trade as fused_ce's lse-based backward)
+        y_us = _fwd_call(x_pad, w, None, tile_group, bm, interpret)
+        ds = jnp.sum(dy.astype(jnp.float32) * y_us.astype(jnp.float32),
+                     axis=-1, keepdims=True)
+    dx = _dx_call(dy, w, scales, tile_group, bm, interpret)
+    dw = _dw_call_impl(x_pad, dy, scales, tile_group, tile_first,
+                       w.shape[0], bm, interpret)
+    # experts owning zero tiles were never visited — their blocks hold
+    # whatever the buffer started with, not zeros
+    dw = jnp.where(counts[:, None, None] > 0, dw, 0.0)
+    return (dx.astype(x_pad.dtype), dw.astype(w.dtype), ds, None, None,
+            None)
+
+
+_gmm.defvjp(_gmm_fwd, _gmm_bwd)
+
+
+def gmm(x_pad: jnp.ndarray, w: jnp.ndarray, tile_group: jnp.ndarray,
+        tile_first: jnp.ndarray, counts: jnp.ndarray, *,
+        scales: Optional[jnp.ndarray] = None, bm: int,
+        interpret: bool) -> jnp.ndarray:
+    """Ragged grouped matmul over a tile-aligned expert-sorted buffer."""
+    return _gmm(x_pad, w, scales, tile_group, tile_first, counts,
+                (bm, interpret))
+
+
+# ---------------------------------------------------------------------------
+# dispatch metadata + the full routed/shared dispatch
+# ---------------------------------------------------------------------------
+
+def _gmm_metadata(flat_e: jnp.ndarray, n_groups: int, n_tiles: int,
+                  bm: int):
+    """(counts, slot_for_sorted_rank, tile_group, tile_first) for a flat
+    expert-id vector. Groups are padded to the next bm multiple; tile t
+    belongs to the group whose padded region covers rows [t*bm, (t+1)*bm).
+    Empty groups own zero tiles (skipped entirely); trailing unused tiles
+    resolve to the last group — their rows carry gate 0, so they add
+    nothing anywhere (forward, dx, dW)."""
+    counts = jnp.zeros((n_groups,), jnp.int32).at[flat_e].add(1)
+    padded = -(-counts // bm) * bm
+    pstart = jnp.cumsum(padded) - padded                   # padded offsets
+    tile_start = pstart // bm                              # (E,)
+    t = jnp.arange(n_tiles, dtype=jnp.int32)
+    tile_group = (jnp.searchsorted(tile_start, t, side="right") - 1
+                  ).astype(jnp.int32)
+    tile_first = (t == tile_start[tile_group]).astype(jnp.int32)
+    starts = jnp.cumsum(counts) - counts                   # packed offsets
+    return counts, pstart, starts, tile_group, tile_first
+
+
+def _pack_rows(x_flat, flat_e, flat_t, flat_g, n_groups, bm):
+    """Sort assignments by expert and place them in the tile-aligned
+    buffer. Returns (x_pad, row_tok, row_gate, metadata...). Unfilled
+    slots keep token 0 with gate 0: computed then zeroed — wasted lanes,
+    never wrong (same trick as scatter_dispatch)."""
+    A = flat_e.shape[0]
+    n_tiles = -(-A // bm) + n_groups
+    P = n_tiles * bm
+
+    order = jnp.argsort(flat_e, stable=True)
+    se, st = flat_e[order], flat_t[order]
+    sg = flat_g[order]
+
+    counts, pstart, starts, tile_group, tile_first = _gmm_metadata(
+        flat_e, n_groups, n_tiles, bm)
+    pos = jnp.arange(A, dtype=jnp.int32) - starts[se]      # rank in group
+    slot = pstart[se] + pos                                # unique, < P
+
+    row_tok = jnp.zeros((P,), jnp.int32).at[slot].set(st)
+    row_gate = jnp.zeros((P, 1), jnp.float32).at[slot, 0].set(sg)
+    x_pad = x_flat[row_tok]
+    return x_pad, row_tok, row_gate, counts, tile_group, tile_first
+
+
+def _apply_activation(h: jnp.ndarray, non_linearity: str) -> jnp.ndarray:
+    """The MLP nonlinearity on the packed hidden buffer (models/mlp.py
+    mlp_apply semantics; imported lazily to avoid an ops<->models cycle)."""
+    from distributed_pytorch_tpu.models.mlp import _activation, _is_gated
+    if _is_gated(non_linearity):
+        x1, x2 = jnp.split(h, 2, axis=-1)
+        gate = jax.nn.silu(x1) if non_linearity.lower() == "swiglu" \
+            else jax.nn.sigmoid(x1)
+        return gate * x2
+    return _activation(non_linearity)(h)
+
+
+def _local_grouped_dispatch(x_flat, topk_idx, topk_gates, experts_fc,
+                            experts_proj, *, non_linearity: str,
+                            n_shared: int, expert_axis: bool,
+                            bm: int, interpret: bool) -> jnp.ndarray:
+    """Per-device dropless dispatch over the LOCAL expert slice.
+
+    Expert ids are global: [0, n_shared) shared (every token, gate 1.0),
+    [n_shared, n_shared + n_routed) routed. With a live 'expert' axis each
+    shard keeps only assignments whose global id falls in its slice;
+    non-local assignments stay in the buffer re-tagged to the last local
+    group with gate 0 (zero contribution, tile-rounding FLOPs only)."""
+    with context.expert_region():
+        N, C = x_flat.shape
+        k = topk_idx.shape[1]
+        E_loc = experts_fc.shape[0]
+        dt = x_flat.dtype
+
+        lo = jnp.int32(0)
+        if expert_axis:
+            lo = jax.lax.axis_index("expert") * E_loc
+
+        tok = jnp.arange(N, dtype=jnp.int32)
+        ids = [jnp.full((N,), e, jnp.int32) for e in range(n_shared)]
+        gts = [jnp.ones((N,), jnp.float32) for _ in range(n_shared)]
+        toks = [tok for _ in range(n_shared)]
+        ids.append((topk_idx + n_shared).astype(jnp.int32).reshape(-1))
+        gts.append(topk_gates.astype(jnp.float32).reshape(-1))
+        toks.append(jnp.repeat(tok, k))
+        flat_e = jnp.concatenate(ids)
+        flat_g = jnp.concatenate(gts)
+        flat_t = jnp.concatenate(toks)
+
+        local = (flat_e >= lo) & (flat_e < lo + E_loc)
+        flat_e = jnp.where(local, flat_e - lo, E_loc - 1)
+        flat_g = jnp.where(local, flat_g, 0.0)
+
+        x_pad, row_tok, row_gate, counts, tile_group, tile_first = \
+            _pack_rows(x_flat, flat_e, flat_t, flat_g, E_loc, bm)
+
+        h = gmm(x_pad, experts_fc.astype(dt), tile_group, tile_first,
+                counts, bm=bm, interpret=interpret)
+        h = _apply_activation(h, non_linearity)
+        y = gmm(h, experts_proj.astype(dt), tile_group, tile_first,
+                counts, scales=row_gate, bm=bm, interpret=interpret)
+
+        out = jnp.zeros_like(x_flat).at[row_tok].add(y)
+        if expert_axis:
+            out = jax.lax.psum(out, "expert")
+        return out
+
+
+def grouped_usable(cfg, batch_size: int, dtype) -> bool:
+    """Static gate for the grouped path. False -> callers fall back to the
+    'dense' combine (identical dropless semantics, E/k x the FLOPs) — the
+    same degrade-don't-crash contract as loss_impl='pallas' (gpt.py)."""
+    if getattr(cfg, "pp_stages", 1) > 1:
+        # the pipeline vmaps Blocks over the layer axis; neither shard_map
+        # nor pallas_call composes with that on this jax
+        return False
+    if context.in_expert_region() or context.in_sp_region():
+        return False
+    fc_out = 2 * cfg.up_dim \
+        if cfg.non_linearity.lower() in ("swiglu", "glu") else cfg.up_dim
+    lane = 128 if jax.default_backend() == "tpu" else 8
+    if any(d % lane for d in (cfg.n_embd, cfg.up_dim, fc_out)):
+        return False
+    if jax.default_backend() == "tpu" and \
+            jnp.dtype(dtype) not in (jnp.dtype(jnp.float32),
+                                     jnp.dtype(jnp.bfloat16)):
+        return False
+    mesh = context.get_mesh()
+    if mesh is not None:
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        if sizes.get("model", 1) > 1 or sizes.get("seq", 1) > 1:
+            return False  # tp shards fc_out, sp shards T: scatter/dense
+        if batch_size % sizes.get("data", 1):
+            return False
+        if cfg.n_exp % sizes.get("expert", 1):
+            return False
+    return True
+
+
+def grouped_dispatch(x_flat: jnp.ndarray, topk_idx: jnp.ndarray,
+                     topk_gates: jnp.ndarray, experts_fc: jnp.ndarray,
+                     experts_proj: jnp.ndarray, *, non_linearity: str,
+                     n_shared: int = 0,
+                     interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Dropless grouped-matmul MoE dispatch (module docstring).
+
+    x_flat (N, C); topk_idx/topk_gates (N, k) over the ROUTED experts;
+    experts_fc/experts_proj (n_exp, ...) stacked kernels INCLUDING the
+    n_shared leading shared experts. Returns shared + routed outputs
+    combined, (N, C). Gate with `grouped_usable` first."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    # small tiles keep the tile-rounding waste proportionate on the tiny
+    # interpret-mode test shapes; hardware uses the MXU-sized default
+    bm = 8 if interpret else DEFAULT_BLOCK_M
+
+    mesh = context.get_mesh()
+    local = functools.partial(
+        _local_grouped_dispatch, non_linearity=non_linearity,
+        n_shared=n_shared, bm=bm, interpret=interpret)
+
+    if mesh is None or all(
+            mesh.shape.get(ax, 1) <= 1 for ax in ("data", "expert")):
+        return local(x_flat, topk_idx, topk_gates, experts_fc,
+                     experts_proj, expert_axis=False)
+
+    from distributed_pytorch_tpu.parallel.sharding import moe_dispatch_specs
+    tok_spec, w_spec, out_spec = moe_dispatch_specs()
+    body = compat.shard_map(
+        functools.partial(local, expert_axis=True),
+        mesh=mesh,
+        in_specs=(tok_spec, tok_spec, tok_spec, w_spec, w_spec),
+        out_specs=out_spec,
+    )
+    return body(x_flat, topk_idx, topk_gates, experts_fc, experts_proj)

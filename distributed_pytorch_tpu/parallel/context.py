@@ -61,6 +61,28 @@ def use_mesh(mesh: Optional[Mesh]):
         _current_mesh.reset(token)
 
 
+_in_expert_region: ContextVar[bool] = ContextVar("in_expert_region",
+                                                 default=False)
+
+
+def in_expert_region() -> bool:
+    """True while tracing inside the grouped-MoE dispatch shard_map body
+    (ops/grouped_matmul.py) — mesh-reading helpers (the grouped-usable
+    gate, the scatter dispatch's sharding constraint) must not re-enter
+    mesh-level machinery from inside the per-device region, mirroring
+    in_sp_region for ring attention."""
+    return _in_expert_region.get()
+
+
+@contextlib.contextmanager
+def expert_region():
+    token = _in_expert_region.set(True)
+    try:
+        yield
+    finally:
+        _in_expert_region.reset(token)
+
+
 # --- collective-matmul overlap context (ops/collective_matmul.py) ----------
 #
 # The train step publishes (overlap mode, recipe) for the duration of

@@ -223,6 +223,23 @@ def batch_pspec(recipe: Recipe, mesh: Mesh, *, leading_accum: bool = False) -> P
     return P(b_axis, t_axis)
 
 
+def moe_dispatch_specs() -> tuple[P, P, P]:
+    """shard_map specs for the grouped-MoE dispatch (ops/grouped_matmul.py):
+    (token-tensor spec, stacked-expert-weight spec, output spec).
+
+    Tokens (x_flat / topk_idx / topk_gates, all (N, ...)) split over
+    'data' — they are already stored that way, so entering the region
+    moves no token bytes. Expert-stacked weights split their leading
+    n_exp axis over 'expert' (an all-gather over 'data' materializes the
+    ZeRO-3 shards, exactly the gather GSPMD would emit before a padded
+    dense dispatch). The output returns data-sharded after the in-body
+    psum over 'expert'. One definition here so the dispatch's manual specs
+    cannot drift from the recipe tables above."""
+    tok = P("data", None)
+    w = P("expert", None, None)
+    return tok, w, P("data", None)
+
+
 def named(mesh: Mesh, spec_tree: Any) -> Any:
     """PartitionSpec pytree -> NamedSharding pytree."""
     return jax.tree_util.tree_map(

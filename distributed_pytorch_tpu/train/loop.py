@@ -305,6 +305,8 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
     # ---- loop ------------------------------------------------------------
     stats = {"train_losses": [], "val_losses": [], "step_times": [],
              "tokens_per_sec": [], "mfu": []}
+    if model_cfg.moe:
+        stats["moe_dropped_frac"] = []  # per-synced-step drop fractions
     flops_per_step = M.step_flops(model_cfg, tokens_per_step, T)
     peak = M.peak_flops_per_chip()
 
@@ -386,6 +388,9 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
                 first_window = not stats["train_losses"]
                 for g in got:
                     stats["train_losses"].append(float(g["loss"]))
+                    if "moe_dropped_frac" in g:
+                        stats["moe_dropped_frac"].append(
+                            float(g["moe_dropped_frac"]))
                 pending.clear()
                 if not first_window:               # first window includes compile
                     for _ in got:
@@ -402,9 +407,16 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
                              if peak else "")
                     hbm = M.device_memory_gb()  # reference reserved-GB print,
                     hbm_s = f" | hbm {hbm:5.2f}GB" if hbm else ""  # train.py:356
+                    drop_s = ""
+                    if stats.get("moe_dropped_frac"):
+                        # silent GShard-style drops (scatter mode) become a
+                        # visible per-step number; dense/grouped print 0
+                        drop_s = (f" | moe_drop "
+                                  f"{stats['moe_dropped_frac'][-1]:6.2%}")
                     say(f"iter {it:5d} | loss {loss:.4f} | "
                         f"dt {dt * 1e3:7.1f}ms | "
-                        f"tok/s/chip {tps / n_chips:10.0f}{mfu_s}{hbm_s}")
+                        f"tok/s/chip {tps / n_chips:10.0f}{mfu_s}{hbm_s}"
+                        f"{drop_s}")
 
             if ckpt_due:
                 # interval saves are async: serialization overlaps the next
@@ -431,6 +443,9 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
 
     stats["final_loss"] = stats["train_losses"][-1] if stats["train_losses"] else None
     stats["peak_hbm_gb"] = M.device_memory_gb()
+    if stats.get("moe_dropped_frac"):
+        # headline number for bench JSON: the steady-state drop fraction
+        stats["final_moe_dropped_frac"] = stats["moe_dropped_frac"][-1]
     if stats["step_times"]:
         med = float(np.median(stats["step_times"]))
         stats["median_step_time"] = med

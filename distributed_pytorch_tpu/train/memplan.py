@@ -89,18 +89,54 @@ def _act_bytes_per_token_layer(cfg: LLMConfig, policy: str,
     no O(T^2) probabilities, only the per-row lse (nh).  'attn' drops the
     attention internals (recomputed blockwise), keeping the block input +
     the MLP side. 'block' keeps only the block input; one layer's full set
-    stays as the recompute peak (added by the caller once, not x L)."""
+    stays as the recompute peak (added by the caller once, not x L).
+
+    MoE layers replace the single MLP's hidden activations with one set
+    per expert actually COMPUTED per token: shared + top-k for
+    scatter/grouped, shared + all routed for 'dense' (which evaluates
+    every expert and masks) — plus the router logits. The dispatch
+    gather/scatter buffers are a separate, batch-shaped term
+    (_moe_dispatch_bytes)."""
     C, up = cfg.n_embd, cfg.up_dim
     nkv, hs, nh = cfg.n_kv_heads, cfg.head_size, cfg.n_head
     fc_out = 2 * up if cfg.non_linearity.lower() in ("swiglu", "glu") else up
     attn_part = C + (C + 2 * nkv * hs) + C + nh / dtype_bytes
-    mlp_part = C + fc_out + up + C
+    if cfg.moe:
+        n_eff = cfg.n_shared + (cfg.n_routed if cfg.moe_impl == "dense"
+                                else cfg.n_act_routed)
+        mlp_part = C + n_eff * (fc_out + up + C) + cfg.n_routed
+    else:
+        mlp_part = C + fc_out + up + C
     full = C + attn_part + mlp_part
     if policy == "none":
         return full * dtype_bytes
     if policy == "attn":
         return (2 * C + mlp_part) * dtype_bytes
     return C * dtype_bytes  # 'block': residual stream input only
+
+
+def _moe_dispatch_bytes(cfg: LLMConfig, tokens: int, ep: int,
+                        dtype_bytes: int = 2) -> float:
+    """Per-device bytes of the MoE dispatch buffers per layer (the token
+    gather on the way in + the combined output on the way out, both live
+    for backward).
+
+    'scatter': the (E, cap, C) buffers shard (expert, data) over the mesh
+    (models/mlp._expert_constraint), so each device holds
+    capacity_factor * k * tokens / ep rows per side.
+    'grouped': the tile-aligned packed buffer is per-DATA-shard tokens x
+    (k + n_shared) rows (ops/grouped_matmul.py; its static size cannot
+    shrink with ep — any shard could receive every assignment), one
+    (P, C) gather + one (P, C) output. 'dense' dispatches via the combine
+    einsum — no buffers."""
+    if not cfg.moe or cfg.moe_impl == "dense":
+        return 0.0
+    C = cfg.n_embd
+    if cfg.moe_impl == "scatter":
+        rows = cfg.capacity_factor * cfg.n_act_routed * tokens / max(ep, 1)
+    else:  # grouped
+        rows = (cfg.n_act_routed + cfg.n_shared) * tokens
+    return rows * 2 * C * dtype_bytes * cfg.n_layer
 
 
 @dataclasses.dataclass(frozen=True)
@@ -126,20 +162,40 @@ class HBMPlan:
                 f"{self.hbm_gb:.0f} GiB ({fit}) | {b}")
 
 
+def _expert_param_count(cfg: LLMConfig) -> int:
+    """Parameters in the stacked (n_exp, ...) expert leaves — the slice of
+    the model the 'expert' mesh axis shards (parallel/sharding.py expert
+    rule), on top of whatever the recipe's data sharding does."""
+    if not cfg.moe:
+        return 0
+    fc_out = 2 * cfg.up_dim \
+        if cfg.non_linearity.lower() in ("swiglu", "glu") else cfg.up_dim
+    per_expert = cfg.n_embd * fc_out + cfg.up_dim * cfg.n_embd
+    return cfg.n_layer * cfg.n_exp * per_expert
+
+
 def estimate_peak_gb(cfg: LLMConfig, recipe: str, micro_batch: int,
-                     policy: str, dp: int, sp: int = 1,
+                     policy: str, dp: int, sp: int = 1, ep: int = 1,
                      optimizer: str = "adamw",
                      n_params: Optional[int] = None) -> tuple[float, dict]:
     """(est peak GiB per device, breakdown dict). `policy` in
-    'none'|'attn'|'block'. `micro_batch` is per-data-shard sequences."""
+    'none'|'attn'|'block'. `micro_batch` is per-data-shard sequences.
+    `ep`: 'expert' mesh-axis size — stacked (E, ...) expert leaves (and
+    their moments/accumulators) divide by it on top of the recipe's data
+    sharding."""
     P = n_params if n_params is not None else param_count(cfg)
     p_div = dp if recipe in _PARAM_SHARDED else 1
     o_div = dp if recipe in _OPT_SHARDED else 1
     g_div = dp if recipe in _GRAD_SHARDED else 1
+    Pe = _expert_param_count(cfg) if ep > 1 else 0
+    Pd = P - Pe  # dense (non-expert-stacked) params
 
-    params_b = P * 4 / p_div
-    opt_b = P * 4 * _OPT_MULT.get(optimizer, 2.0) / o_div
-    grads_b = P * 4 / g_div  # fp32 accumulator (train/step.py)
+    def _split(div):
+        return Pd / div + Pe / (div * ep)
+
+    params_b = _split(p_div) * 4
+    opt_b = _split(o_div) * 4 * _OPT_MULT.get(optimizer, 2.0)
+    grads_b = _split(g_div) * 4  # fp32 accumulator (train/step.py)
 
     T_local = cfg.block_size // max(sp, 1)
     tokens = micro_batch * T_local
@@ -170,6 +226,9 @@ def estimate_peak_gb(cfg: LLMConfig, recipe: str, micro_batch: int,
         "loss": loss_b / 2 ** 30,
         "gather": gather_b / 2 ** 30,
     }
+    if cfg.moe:
+        breakdown["moe_dispatch"] = _moe_dispatch_bytes(
+            cfg, tokens, ep) / 2 ** 30
     total = sum(breakdown.values()) * _FUDGE
     return total, {k: round(v, 3) for k, v in breakdown.items()}
 
@@ -195,7 +254,7 @@ def plan_memory(model_cfg: LLMConfig, train_cfg: TrainConfig, *,
     plan = resolve_plan(recipe, n_devices, tp_size=train_cfg.tp_size,
                         ep_size=train_cfg.ep_size, sp_size=train_cfg.sp_size,
                         pp_size=train_cfg.pp_size, dp_size=train_cfg.dp_size)
-    dp, sp = plan.data, plan.seq
+    dp, sp, ep = plan.data, plan.seq, plan.expert
     budget = hbm_gb if hbm_gb is not None else device_hbm_gb()
     n_params = param_count(model_cfg)
     T = model_cfg.block_size
@@ -210,7 +269,7 @@ def plan_memory(model_cfg: LLMConfig, train_cfg: TrainConfig, *,
         accum = train_cfg.total_batch_size // tokens_per_micro
         for policy in ("none", "attn", "block"):
             est, breakdown = estimate_peak_gb(
-                model_cfg, recipe, mb, policy, dp, sp,
+                model_cfg, recipe, mb, policy, dp, sp, ep,
                 optimizer=train_cfg.optimizer, n_params=n_params)
             cand = HBMPlan(
                 preset=preset_name, recipe=recipe, micro_batch=mb,

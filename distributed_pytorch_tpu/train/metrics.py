@@ -79,6 +79,29 @@ def matmul_params_per_token(cfg: LLMConfig) -> int:
         + cfg.n_layer * ffn + lm_head
 
 
+def moe_overcompute_factor(cfg: LLMConfig) -> float:
+    """Executed / useful expert-FFN FLOPs for the configured dispatch.
+
+    MFU here always counts ACTIVE-expert FLOPs (useful work); this factor
+    says how much the dispatch overspends to deliver them: 'dense' runs
+    every routed expert on every token (n_routed / k), 'scatter' pads each
+    expert to capacity (~capacity_factor, load-dependent), 'grouped'
+    streams packed tokens (~1.0, tile-rounding only). The bench/sweep MoE
+    legs print it next to MFU so a dense-dispatch MFU number can't
+    masquerade as kernel efficiency."""
+    if not cfg.moe:
+        return 1.0
+    active = cfg.n_shared + cfg.n_act_routed
+    if cfg.moe_impl == "dense":
+        return (cfg.n_shared + cfg.n_routed) / active
+    if cfg.moe_impl == "scatter":
+        # capacity slots are computed whether filled or not; with a
+        # balanced router utilization -> 1/capacity_factor
+        return (cfg.n_shared + cfg.capacity_factor * cfg.n_act_routed) \
+            / active
+    return 1.0  # grouped: dropless AND packed
+
+
 def step_flops(cfg: LLMConfig, tokens_per_step: int, seq_len: int) -> float:
     """Total train-step FLOPs (fwd + bwd [+ remat fwd]).
 

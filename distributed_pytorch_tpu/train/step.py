@@ -33,6 +33,20 @@ from distributed_pytorch_tpu.train.state import TrainState
 _SHARDED_GRAD_RECIPES = ("zero2", "fsdp", "fsdp_tp", "sp")
 
 
+def _dropped_frac(moe_state) -> jnp.ndarray:
+    """Mean of the per-layer `dropped_frac` moe_state leaves (models/mlp.py):
+    the fraction of routed assignments silently dropped past capacity in
+    'scatter' mode this step — 0 by construction for 'dense'/'grouped'.
+    Leaves are scalars in the loop model and (L,) under the pipeline's
+    stacked moe_state."""
+    vals = [jnp.mean(leaf) for path, leaf in
+            jax.tree_util.tree_flatten_with_path(moe_state)[0]
+            if getattr(path[-1], "key", None) == "dropped_frac"]
+    if not vals:
+        return jnp.float32(0.0)
+    return jnp.mean(jnp.stack(vals))
+
+
 def _grad_shardings(params, recipe: str, mesh: Mesh):
     """NamedSharding tree for the grad accumulator (leaves, safe to tree_map)."""
     p_specs = shd.params_pspecs(params, recipe, mesh)
@@ -150,6 +164,8 @@ def make_train_step(model, tx: optax.GradientTransformation,
             "loss": losses.mean(),
             "grad_norm": optax.global_norm(grads),
         }
+        if model_cfg.moe:
+            metrics["moe_dropped_frac"] = _dropped_frac(new_moe)
         new_state = TrainState(step=state.step + 1, params=new_params,
                                opt_state=new_opt, moe_state=new_moe)
         return new_state, metrics
@@ -161,6 +177,8 @@ def make_train_step(model, tx: optax.GradientTransformation,
                                                    leading_accum=True))
     repl = NamedSharding(mesh, P())
     metrics_sh = {"loss": repl, "grad_norm": repl}
+    if model_cfg.moe:
+        metrics_sh["moe_dropped_frac"] = repl
     return jax.jit(
         train_step,
         in_shardings=(state_sharding, batch_sh, batch_sh),

@@ -12,8 +12,13 @@ from __future__ import annotations
 
 import argparse
 import itertools
+import os
 import sys
 import time
+
+# runnable as `python scripts/mfu_sweep.py` without an installed package or
+# PYTHONPATH: the repo root owns `distributed_pytorch_tpu`
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 import jax.numpy as jnp
@@ -31,25 +36,36 @@ def time_variant(batch: int, attn_impl: str, act_recomp: bool,
 
     from distributed_pytorch_tpu.config import PRESETS
     # per-subprocess env knobs (like FLASH_BLOCK_*): SWEEP_PRESET picks the
-    # ladder rung, SWEEP_RECIPE the parallelism (OVERLAP/OVERLAP_RING are
-    # read by ops/collective_matmul.py directly)
+    # ladder rung, SWEEP_RECIPE the parallelism, SWEEP_MOE the MoE dispatch
+    # impl (dense|scatter|grouped — swaps the FFN for the bench MoE),
+    # SWEEP_EP the 'expert' mesh-axis size (OVERLAP/OVERLAP_RING/GMM_BLOCK_*
+    # are read by the ops modules directly)
     preset = _os.environ.get("SWEEP_PRESET", "gpt2_124m")
     recipe = _os.environ.get("SWEEP_RECIPE", "single")
+    moe_impl = _os.environ.get("SWEEP_MOE", "")
+    ep_size = int(_os.environ.get("SWEEP_EP", "1"))
+    moe_kw = {}
+    if moe_impl:
+        # same MoE shape as bench.py's moe_* legs so the two measure the
+        # same model (active params stay 124M-class)
+        moe_kw = dict(moe=True, n_exp=8, n_shared=1, n_act=3, up_dim=1024,
+                      moe_impl=moe_impl)
     model_cfg = PRESETS[preset](act_recomp=act_recomp,
                                 act_recomp_policy="attn",
-                                loss_impl=loss_impl)
+                                loss_impl=loss_impl, **moe_kw)
     n_dev = len(jax.devices()) if recipe != "single" else 1
     train_cfg = TrainConfig(
         dataset="synthetic", total_batch_size=batch * n_dev * 1024,
         batch_size=batch, max_iters=iters, parallelism=recipe,
-        attn_impl=attn_impl, eval=False, save_model=False, save_stats=False,
+        attn_impl=attn_impl, ep_size=ep_size,
+        eval=False, save_model=False, save_stats=False,
         compute_dtype="bfloat16")
 
     try:
         mesh = None
         if recipe != "single":
             from distributed_pytorch_tpu.parallel.mesh import mesh_for
-            mesh = mesh_for(recipe)
+            mesh = mesh_for(recipe, ep_size=ep_size)
         model, tx, state, state_sh = create_train_state(model_cfg,
                                                         train_cfg, mesh)
         step = make_train_step(model, tx, model_cfg, train_cfg, mesh,
@@ -101,6 +117,12 @@ def time_variant(batch: int, attn_impl: str, act_recomp: bool,
     hbm = M.device_memory_gb()
     tag = "" if (preset, recipe) == ("gpt2_124m", "single") \
         else f" [{preset}/{recipe}]"
+    if moe_impl:
+        # MFU counts active-expert FLOPs; the overcompute factor says how
+        # much the dispatch overspends delivering them (dense E/k x,
+        # scatter ~cf x, grouped ~1 x — train/metrics.py)
+        tag += (f" [moe={moe_impl} "
+                f"overcompute={M.moe_overcompute_factor(model_cfg):.2f}x]")
     print(f"batch={batch:3d} attn={attn_impl:6s} remat={act_recomp!s:5s} "
           f"loss={loss_impl:9s} | {dt * 1e3:7.1f} ms | "
           f"{tokens / dt:9.0f} tok/s | mfu {mfu:6.2%} | "
@@ -108,7 +130,8 @@ def time_variant(batch: int, attn_impl: str, act_recomp: bool,
           flush=True)
     return {"batch": batch, "attn": attn_impl, "remat": act_recomp,
             "loss": loss_impl, "ms": dt * 1e3, "mfu": mfu,
-            "preset": preset, "recipe": recipe}
+            "preset": preset, "recipe": recipe,
+            "moe_impl": moe_impl or None}
 
 
 def main():
@@ -202,6 +225,27 @@ def main():
             (16, "pallas", False, "fused", {"SWEEP_RECIPE": "fsdp"}),
             (16, "pallas", False, "fused", {"SWEEP_RECIPE": "fsdp",
                                             "OVERLAP": "on"}),
+        ]
+    elif args.variants == "moe":
+        # MOE_IMPL A/B inside the real train step (ISSUE round 7): dense
+        # combine vs capacity-scatter vs the dropless grouped kernel, on
+        # one chip and under expert parallelism. The first TPU window runs
+        # this to self-select the MoE dispatch default (the bench
+        # mini-sweep's moe_* legs measure the same matrix end-to-end).
+        grid = [
+            (16, "xla", False, "fused", {"SWEEP_MOE": "dense"}),
+            (16, "xla", False, "fused", {"SWEEP_MOE": "scatter"}),
+            (16, "xla", False, "fused", {"SWEEP_MOE": "grouped"}),
+            (16, "xla", False, "fused", {"SWEEP_MOE": "grouped",
+                                         "GMM_BLOCK_M": "256"}),
+            (16, "xla", False, "fused", {"SWEEP_MOE": "grouped",
+                                         "GMM_BLOCK_N": "1024"}),
+            (16, "xla", False, "fused", {"SWEEP_MOE": "scatter",
+                                         "SWEEP_RECIPE": "ep",
+                                         "SWEEP_EP": "2"}),
+            (16, "xla", False, "fused", {"SWEEP_MOE": "grouped",
+                                         "SWEEP_RECIPE": "ep",
+                                         "SWEEP_EP": "2"}),
         ]
     elif args.variants == "ladder":
         # the 350M-1.5B rungs (BASELINE.json): batch/remat per the static

@@ -40,16 +40,21 @@ def _fsdp_mesh():
 CASES = [
     # (names, w shape, transpose_b): c_fc shards its OUTPUT dim over
     # 'data' (N-ring), c_proj its contraction dim (K-ring), the embedding
-    # rings vocab slices of the transposed lm-head matmul
+    # rings vocab slices of the transposed lm-head matmul; the attention
+    # projections (round 7: routed via _OverlapDense, models/attention.py)
+    # ring whatever axis the fsdp table picked for their kernels
     (("c_fc",), (32, 96), False),
     (("c_proj",), (64, 32), False),
     (("tkn_emb", "embedding"), (128, 32), True),
+    (("c_attn", "kernel"), (32, 64), False),
+    (("c_proj", "kernel"), (32, 32), False),
 ]
 
 
 @pytest.mark.parametrize("ring", ["uni", "bidir"])
 @pytest.mark.parametrize("names,wshape,tb", CASES,
-                         ids=["c_fc", "c_proj", "lm_head"])
+                         ids=["c_fc", "c_proj", "lm_head", "attn_qkv",
+                              "attn_out"])
 def test_ring_matches_plain_matmul(monkeypatch, ring, names, wshape, tb):
     monkeypatch.setenv("OVERLAP", "on")
     monkeypatch.setenv("OVERLAP_RING", ring)
@@ -180,17 +185,33 @@ def test_overlap_step_matches_oracle(overlap_on, recipe, kw, accum):
 def test_overlap_rings_actually_engage(monkeypatch):
     """Guard against the dispatcher silently declining everywhere (which
     would make the parity suite vacuous): under OVERLAP=on + fsdp mesh the
-    MLP matmuls must take the ring path."""
+    MLP matmuls AND the attention projections (c_attn / attention c_proj,
+    the round-7 call sites) must take the ring path."""
     monkeypatch.setenv("OVERLAP", "on")
     calls = []
+    seen_names = []
     orig = cm._build_cm
+    orig_dispatch = cm.maybe_overlap_matmul
 
     def spy(*a, **k):
         calls.append(a)
         return orig(*a, **k)
 
+    def spy_dispatch(x, w, *, names, **k):
+        y = orig_dispatch(x, w, names=names, **k)
+        if y is not None:
+            seen_names.append(names)
+        return y
+
     monkeypatch.setattr(cm, "_build_cm", spy)
+    monkeypatch.setattr(cm, "maybe_overlap_matmul", spy_dispatch)
+    # the model modules import the dispatcher lazily from the module, so
+    # the monkeypatched symbol is what they call
     mc = LLMConfig(**TINY)
     mesh = _fsdp_mesh()
     _run(mc, "fsdp", mesh, 1)
     assert calls, "OVERLAP=on fsdp step never reached the ring builder"
+    assert ("c_attn", "kernel") in seen_names, \
+        "fused qkv projection never rang (attention overlap call site)"
+    assert ("c_proj", "kernel") in seen_names, \
+        "attention out-projection never rang"

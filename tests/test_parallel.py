@@ -22,6 +22,17 @@ MOE = dict(**TINY, moe=True, n_exp=8, n_shared=1, n_act=3)
 # scatter vs single-device dense oracle: generous capacity -> no drops, so
 # the trajectories must agree (the ep recipe's production dispatch)
 MOE_SCATTER = dict(**MOE, moe_impl="scatter", capacity_factor=8.0)
+# dropless grouped kernel (ops/grouped_matmul.py): the sharded step runs
+# the Pallas dispatch inside shard_map over ('data','expert'); its oracle
+# runs the same kernel unsharded — grouped-vs-dense parity is covered at
+# module level in test_grouped_matmul.py
+MOE_GROUPED = dict(**MOE, moe_impl="grouped")
+# pp x MoE with moe_impl='grouped': the pipeline vmaps Blocks, so the
+# dispatch degrades to the dense combine (identical dropless semantics)
+# while stats_weight keeps masking bubble slots — the config must train
+# and match the oracle either way
+PP_MOE_GROUPED = dict(**MOE, moe_impl="grouped", pp_stages=2,
+                      pp_microbatches=4)
 # forced T-chunked fused CE (ops/losses.py lax.scan path): tiny vocab never
 # auto-chunks, so an explicit loss_chunk makes sharded runs exercise the
 # scan + checkpoint over 'data'/'model'-sharded embeddings
@@ -138,10 +149,17 @@ RECIPES = [
     ("fsdp", MOE_SCATTER, {"sp_size": 2}),
     # MLA's absorbed projections under megatron-style TP
     ("fsdp_tp", MLA, {"tp_size": 2}),
+    # dropless grouped dispatch under expert parallelism (round 7): pure
+    # ep, and composed with ZeRO-3 param sharding (the MoE-at-scale mesh)
+    ("ep", MOE_GROUPED, {"ep_size": 2}),
+    ("fsdp", MOE_GROUPED, {"ep_size": 2}),
+    # pp x MoE exercising stats_weight with moe_impl='grouped'
+    ("pp", PP_MOE_GROUPED, {"pp_size": 2}),
 ]
-_RECIPE_IDS = [r[0] for r in RECIPES[:-8]] + [
+_RECIPE_IDS = [r[0] for r in RECIPES[:-11]] + [
     "ep_scatter", "fsdp_x_ep", "fsdp_x_sp", "fsdp_chunked_ce",
-    "tp_chunked_ce", "pp", "moe_x_sp", "mla_x_tp"]
+    "tp_chunked_ce", "pp", "moe_x_sp", "mla_x_tp",
+    "ep_grouped", "fsdp_x_ep_grouped", "pp_moe_grouped"]
 
 
 _ORACLE_CACHE: dict = {}

@@ -106,6 +106,117 @@ def _last_tpu_reference() -> dict | None:
     return best
 
 
+def _decode_bench(platform: str) -> dict:
+    """Decode-path legs (BENCH_DECODE=1): prefill latency, steady-state
+    tokens/sec/chip at full slot occupancy, and a ragged-admission window
+    (random per-sequence budgets -> slots retire and refill) with its
+    occupancy — the numbers the first TPU window needs to A/B flash-decode
+    vs naive (FLASH_DECODE env) and size the serving config. Emits the same
+    one-line JSON schema as the training legs."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_pytorch_tpu.config import LLMConfig, flagship_gpt124m
+    from distributed_pytorch_tpu.engine import DecodeEngine
+    from distributed_pytorch_tpu.models.gpt import LLM
+    from distributed_pytorch_tpu.train import metrics as M
+
+    n_dev = len(jax.devices())
+    if platform == "tpu":
+        cfg = flagship_gpt124m()
+        S = int(os.environ.get("BENCH_DECODE_LEN", "1024"))
+        slots = int(os.environ.get("BENCH_DECODE_SLOTS", "32"))
+        dtype, iters, ragged_lo, ragged_hi = jnp.bfloat16, 32, 8, 64
+        preset = "gpt2_124m"
+    else:  # CPU proxy: tiny model so the harness still gets a line
+        cfg = LLMConfig(vocab_size=1024, block_size=128, n_embd=128,
+                        n_head=4, n_kv_heads=4, attn="mha", n_layer=2,
+                        up_dim=256, non_linearity="swiglu", pos_emb="rope")
+        S, slots = 128, 4
+        dtype, iters, ragged_lo, ragged_hi = jnp.float32, 8, 2, 6
+        preset = "cpu_tiny"
+    model = LLM(cfg, compute_dtype=dtype, attn_impl="auto")
+    rng = jax.random.PRNGKey(0)
+    dummy = jnp.zeros((1, cfg.block_size), jnp.int32)
+    variables = jax.jit(model.init)({"params": rng, "dropout": rng},
+                                    dummy, dummy)
+    eng = DecodeEngine(model, variables, n_slots=slots, max_len=S,
+                       temperature=1.0, top_k=50)
+
+    prompt_len = S // 2
+    npr = np.random.default_rng(0)
+
+    def mk():
+        return list(npr.integers(0, cfg.vocab_size, prompt_len))
+
+    big = 10 ** 9  # never retire by budget inside the timed window
+    t0 = time.perf_counter()
+    eng.admit(mk(), big)                     # compiles the prefill bucket
+    prefill_compile_s = time.perf_counter() - t0
+    prefill_times = []
+    for _ in range(min(3, slots - 1)):
+        t0 = time.perf_counter()
+        eng.admit(mk(), big)
+        prefill_times.append(time.perf_counter() - t0)
+    while eng.free_slots:
+        eng.admit(mk(), big)
+    eng.step()                               # compiles the fused step
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        eng.step()
+    jax.device_get(eng.tok)
+    dt = time.perf_counter() - t0
+    steady = slots * iters / dt
+
+    # MBU from the bytes-moved model at the window's mean cache length
+    mean_len = prompt_len + 1 + iters // 2
+    bw = M.peak_hbm_bw_per_chip()
+    bytes_step = M.decode_step_bytes(cfg, slots, mean_len,
+                                     param_dtype_size=jnp.dtype(dtype).itemsize,
+                                     cache_dtype_size=jnp.dtype(dtype).itemsize)
+    mbu = (bytes_step * iters / dt) / (bw * n_dev) if bw else None
+
+    # ragged window: drain the full slots with random budgets via fresh
+    # admissions as they retire; occupancy = mean live fraction
+    for slot in list(eng._slots):            # re-budget the live set
+        eng._slots[slot].max_new = int(npr.integers(ragged_lo, ragged_hi))
+    queue = [(mk(), int(npr.integers(ragged_lo, ragged_hi)))
+             for _ in range(slots)]
+    live_steps, ragged_steps, ragged_toks = [], 0, 0
+    t0 = time.perf_counter()
+    while queue or eng.n_live:
+        while queue and eng.free_slots:
+            p, budget = queue.pop(0)
+            eng.admit(p, budget)
+        if eng.n_live:
+            live_steps.append(eng.n_live)
+            ragged_toks += eng.n_live
+            eng.step()
+            ragged_steps += 1
+    ragged_dt = time.perf_counter() - t0
+    occupancy = float(np.mean(live_steps) / slots) if live_steps else 0.0
+
+    return {"metric": ("decode_tokens_per_sec_per_chip" if platform == "tpu"
+                       else "cpu_proxy_decode_tokens_per_sec_per_chip"),
+            "value": round(steady / n_dev, 1), "unit": "tok/s/chip",
+            "vs_baseline": 0,
+            "prefill_ms": round(float(np.median(prefill_times)) * 1e3, 2)
+            if prefill_times else None,
+            "prefill_compile_s": round(prefill_compile_s, 2),
+            "prefill_tokens": prompt_len,
+            "ragged_tokens_per_sec_per_chip":
+                round(ragged_toks / ragged_dt / n_dev, 1),
+            "ragged_occupancy": round(occupancy, 3),
+            "mbu": round(mbu, 4) if mbu is not None else None,
+            "n_slots": slots, "cache_len": S,
+            "flash_decode": os.environ.get("FLASH_DECODE", "auto"),
+            "n_chips": n_dev, "device": jax.devices()[0].device_kind,
+            "preset": preset}
+
+
 def run_bench(platform: str, only_recipe: str | None = None) -> dict:
     """Worker-side measurement. `platform` is 'tpu' or 'cpu'.
 
@@ -137,6 +248,12 @@ def run_bench(platform: str, only_recipe: str | None = None) -> dict:
     from distributed_pytorch_tpu.train.loop import train
 
     n_dev = len(jax.devices())
+
+    if os.environ.get("BENCH_DECODE"):
+        if platform == "tpu":
+            assert jax.default_backend() == "tpu", \
+                f"TPU probe passed but worker got {jax.default_backend()!r}"
+        return _decode_bench(platform)
 
     if platform == "tpu":
         # The probe passing doesn't guarantee THIS process gets the TPU:
@@ -382,10 +499,24 @@ def main() -> None:
                 if r:
                     r["config"] = name
                     candidates.append(r)
+            # decode-path legs (round 8): flash-decode vs naive A/B.
+            # Separate list — their tok/s values are not MFU-comparable,
+            # so they must never win the headline max() below.
+            decode_results = {}
+            for name, env in [
+                    ("decode_flash", {"BENCH_DECODE": "1",
+                                      "FLASH_DECODE": "on"}),
+                    ("decode_naive", {"BENCH_DECODE": "1",
+                                      "FLASH_DECODE": "off"})]:
+                r = _spawn_worker("tpu", timeout_s=900, extra_env=env)
+                if r:
+                    decode_results[name] = r
             if candidates:
                 out = max(candidates, key=lambda r: r.get("value", 0))
                 out["configs_tried"] = {
                     c["config"]: c["value"] for c in candidates}
+                if decode_results:
+                    out["decode_legs"] = decode_results
         if out is None:
             out = _spawn_worker("tpu", timeout_s=1800)
         if out and out.get("n_chips", 1) > 1:

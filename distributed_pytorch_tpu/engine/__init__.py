@@ -1,0 +1,6 @@
+"""Serving-side decode engine: continuous batching over a slot-based KV
+cache. See engine/decode.py."""
+
+from distributed_pytorch_tpu.engine.decode import DecodeEngine
+
+__all__ = ["DecodeEngine"]

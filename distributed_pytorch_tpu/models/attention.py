@@ -84,10 +84,31 @@ class _OverlapDense(nn.Module):
 
 
 def _update_cache(cache_arr: jnp.ndarray, new: jnp.ndarray, pos) -> jnp.ndarray:
-    """Write `new` (B, T, ...) into the static buffer at [:, pos:pos+T]."""
+    """Write `new` (B, T, ...) into the static buffer at [:, pos:pos+T].
+
+    `pos` is the GLOBAL token position: a static int (prefill), a traced
+    scalar, or a per-sequence (B,) array (slot-based ragged decode —
+    independent sequences in a batch sit at different positions). Traced
+    positions write modulo the buffer length: the cache is a RING — once
+    the window fills, the new row lands on the slot holding the oldest
+    entry. One O(1) dynamic-slice write per token replaces the legacy
+    roll-by-one window's O(S) HBM shift (generate.py pre-round-8), and is
+    content-identical to it: both keep exactly the last S entries, and
+    attention is permutation-invariant over fully-valid slots."""
+    new = new.astype(cache_arr.dtype)
     zeros = (0,) * (new.ndim - 2)
-    return jax.lax.dynamic_update_slice(cache_arr, new.astype(cache_arr.dtype),
-                                        (0, pos, *zeros))
+    S = cache_arr.shape[1]
+    if isinstance(pos, int):
+        return jax.lax.dynamic_update_slice(cache_arr, new, (0, pos, *zeros))
+    pos = jnp.asarray(pos, jnp.int32)
+    start = jax.lax.rem(pos, jnp.int32(S))
+    if pos.ndim == 0:
+        return jax.lax.dynamic_update_slice(cache_arr, new,
+                                            (jnp.int32(0), start, *zeros))
+    # per-sequence slots: one row-write per sequence
+    return jax.vmap(
+        lambda c, n, p: jax.lax.dynamic_update_slice(c, n, (p, *zeros))
+    )(cache_arr, new, start)
 
 
 class GQA(nn.Module):
@@ -182,12 +203,22 @@ def _absorbed_decode(q_c, c_kv, kuk, kuv, pos, scale, extra_scores=None):
     if extra_scores is not None:
         attn = attn + extra_scores
     attn = attn * scale
-    qpos = pos + jnp.arange(T)[:, None]
-    kpos = jnp.arange(S)[None, :]
-    attn = jnp.where((qpos >= kpos)[None, None], attn, -jnp.inf)
+    attn = jnp.where(_causal_cache_mask(pos, T, S)[:, None], attn, -jnp.inf)
     attn = jax.nn.softmax(attn.astype(jnp.float32), axis=-1).astype(dt)
     out_lat = jnp.einsum("bnts,bsl->btnl", attn, c_kv.astype(dt))
     return jnp.einsum("btnl,lnh->btnh", out_lat, kuv_h).reshape(B, T, nh * hs)
+
+
+def _causal_cache_mask(pos, T: int, S: int) -> jnp.ndarray:
+    """(B|1, T, S) bool mask: query at global position pos+i attends cache
+    slots j <= pos+i. `pos` scalar or per-sequence (B,) array. Under the
+    ring cache (global pos >= S) every slot is valid — slot indices never
+    exceed S-1, so the comparison degenerates to all-true, matching the
+    legacy roll window's fully-valid buffer."""
+    qpos = (jnp.reshape(jnp.asarray(pos, jnp.int32), (-1, 1, 1))
+            + jnp.arange(T)[None, :, None])
+    kpos = jnp.arange(S)[None, None, :]
+    return qpos >= kpos
 
 
 class NaiveMLA(nn.Module):

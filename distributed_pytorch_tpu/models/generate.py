@@ -8,21 +8,34 @@ Reference parity (`LLM.generate`, single-gpu/model.py:700-747):
   block_size-1 — a sliding window (reference :711-730).
 
 TPU-first design (SURVEY §7 hard part (c) — static shapes for XLA):
-* caches are fixed (B, S, ...) buffers + an integer position (models/gpt.py
+* caches are fixed (B, S, ...) buffers + integer positions (models/gpt.py
   `init_cache`); the whole decode loop is ONE `lax.scan` inside ONE jit —
   no per-token retrace, no concat-and-grow;
-* the sliding window becomes a roll-by-one of the cache buffers under
-  `jnp.where(full, ...)` instead of a Python-side trim, so the compiled
-  step is position-independent;
+* the sliding window is a RING: the cache write lands at `pos % S`
+  (models/attention.py `_update_cache`), so a full window costs one O(1)
+  row write instead of the pre-round-8 roll-by-one's O(S) HBM shift of
+  every layer's buffer per token. Content-equivalent to the roll (both
+  keep exactly the last S entries; attention is permutation-invariant over
+  valid slots), and when `T0 + max_new_tokens <= max_len` — the common
+  case — nothing window-related is traced at all;
+* `prompt_len` (B,) enables BUCKETED prompts: right-pad each prompt to a
+  shared shape (sample.py buckets to powers of two so repeated prompts
+  reuse one trace), prefill reads logits at each sequence's true last row
+  (`logits_idx`), and decode continues from per-sequence positions — pad
+  rows are overwritten by the first decode steps and causally masked until
+  then, so the output tokens are bit-identical to an unpadded decode;
 * sampling uses a counter-based PRNG key folded per step (reproducible
   regardless of batch size), `jax.lax.top_k` + mask for the top-k filter,
   and `jax.random.categorical` for the multinomial draw; temperature == 0.0
   selects greedy argmax (an extension; the reference divides by zero).
+
+For serving-style continuous batching (admit/retire sequences into a
+long-lived slot cache) use `engine.DecodeEngine`, which builds on the same
+per-sequence position machinery.
 """
 
 from __future__ import annotations
 
-import functools
 from typing import Any, Optional
 
 import jax
@@ -45,28 +58,19 @@ def sample_token(logits: jnp.ndarray, rng, *, temperature: float = 1.0,
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
-def _roll_window(caches, pos: jnp.ndarray, max_len: int):
-    """Sliding-window cache: once `pos` hits the buffer end, shift every
-    layer's cache left by one and clamp the write position to the last slot
-    (the static-shape equivalent of the reference's trim-to-block_size-1,
-    model.py:711-730)."""
-    full = pos >= max_len
-
-    def roll(c):
-        return jnp.where(full, jnp.roll(c, -1, axis=1), c)
-
-    caches = jax.tree_util.tree_map(roll, caches)
-    return caches, jnp.minimum(pos, max_len - 1)
-
-
 def make_generate_fn(model, max_new_tokens: int, *, temperature: float = 1.0,
                      top_k: Optional[int] = None,
                      max_len: Optional[int] = None, cache_dtype=None):
-    """Build a jitted `generate(variables, prompt, rng) -> (B, T0 + new)`.
+    """Build a jitted `generate(variables, prompt, rng[, prompt_len])
+    -> (B, T0 + new)`.
 
     `variables` is the flax variable dict ({'params': ..., ['moe_state': ...]});
     `prompt` (B, T0) int32, T0 <= block_size (crop host-side first — static
-    shapes). The returned function is traced once per (B, T0) shape.
+    shapes). `prompt_len` (B,) int32 marks each row's true length when the
+    prompt buffer is right-padded (bucketed shapes); generated tokens then
+    start at out[:, T0:] while out[b, prompt_len[b]:T0] holds the pad tail.
+    The returned function is traced once per (B, T0) shape (plus once more
+    for the prompt_len variant).
     """
     cfg = model.config
     max_len = max_len or cfg.block_size
@@ -77,15 +81,17 @@ def make_generate_fn(model, max_new_tokens: int, *, temperature: float = 1.0,
     cache_dtype = cache_dtype or model.compute_dtype
 
     if max_new_tokens <= 0:  # reference range(0) no-op, model.py:703
-        return lambda variables, prompt, rng: prompt
+        return lambda variables, prompt, rng, prompt_len=None: prompt
 
-    def apply_step(variables, idx, caches, pos):
+    def apply_step(variables, idx, caches, pos, logits_idx=None):
         logits, _, caches = model.apply(variables, idx, None, caches, pos,
-                                        deterministic=True)
+                                        deterministic=True,
+                                        logits_idx=logits_idx)
         return logits[:, -1, :], caches
 
     @jax.jit
-    def generate(variables: Any, prompt: jnp.ndarray, rng) -> jnp.ndarray:
+    def generate(variables: Any, prompt: jnp.ndarray, rng,
+                 prompt_len=None) -> jnp.ndarray:
         B, T0 = prompt.shape
         assert T0 <= max_len, (
             f"prompt length {T0} exceeds cache size {max_len}; crop to the "
@@ -93,21 +99,28 @@ def make_generate_fn(model, max_new_tokens: int, *, temperature: float = 1.0,
         caches = init_cache(cfg, B, max_len, dtype=cache_dtype)
 
         # Prefill: one full-sequence forward populates every layer's cache.
-        logits, caches = apply_step(variables, prompt, caches, 0)
+        if prompt_len is None:
+            logits, caches = apply_step(variables, prompt, caches, 0)
+            # one shared scalar position: the whole batch advances in
+            # lockstep and each cache update is a single fused row write
+            pos0 = jnp.int32(T0)
+        else:
+            lens = jnp.asarray(prompt_len, jnp.int32)
+            logits, caches = apply_step(variables, prompt, caches, 0,
+                                        logits_idx=lens - 1)
+            pos0 = lens  # (B,): per-sequence slot positions from here on
         tok = sample_token(logits, jax.random.fold_in(rng, 0),
                            temperature=temperature, top_k=top_k)
 
         def step(carry, i):
             tok, caches, pos = carry
-            caches, pos_eff = _roll_window(caches, pos, max_len)
-            logits, caches = apply_step(variables, tok[:, None], caches,
-                                        pos_eff)
+            logits, caches = apply_step(variables, tok[:, None], caches, pos)
             nxt = sample_token(logits, jax.random.fold_in(rng, i),
                                temperature=temperature, top_k=top_k)
             return (nxt, caches, pos + 1), tok
 
         (last, _, _), toks = jax.lax.scan(
-            step, (tok, caches, jnp.int32(T0)),
+            step, (tok, caches, pos0),
             jnp.arange(1, max_new_tokens, dtype=jnp.int32))
         # toks: (max_new_tokens - 1, B) — each step emits its *incoming*
         # token; the final sampled token closes the sequence.

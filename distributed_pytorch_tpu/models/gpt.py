@@ -109,7 +109,13 @@ class LLM(nn.Module):
 
     @nn.compact
     def __call__(self, idx, targets=None, caches=None, pos=0, *,
-                 deterministic: bool = True):
+                 deterministic: bool = True, logits_idx=None):
+        """`pos` is the global position of idx[:, 0] — a static int, a
+        traced scalar, or a per-sequence (B,) array (slot-based ragged
+        decode; each sequence in the batch sits at its own cache
+        position). `logits_idx` (B,) selects which position's logits to
+        return when targets is None (default: the last) — the bucketed
+        prefill path, where right-padded prompts end at different rows."""
         cfg = self.config
         B, T = idx.shape
         dt = self.compute_dtype
@@ -128,12 +134,12 @@ class LLM(nn.Module):
         elif cfg.pos_emb == "learn":
             pos_tab = self.param("pos_emb", _EMBED_INIT,
                                  (cfg.block_size, cfg.n_embd), jnp.float32)
-            p = slice_rows(pos_tab, pos, T)
-            x = x + p.astype(dt)[None]
+            p = slice_rows(pos_tab, pos, T).astype(dt)
+            x = x + (p if p.ndim == 3 else p[None])  # per-seq rows vs shared
         elif cfg.pos_emb == "sin":
             tab = _sin_table(cfg.block_size, cfg.n_embd)
-            p = slice_rows(tab, pos, T)
-            x = x + p.astype(dt)[None]
+            p = slice_rows(tab, pos, T).astype(dt)
+            x = x + (p if p.ndim == 3 else p[None])
 
         x = nn.Dropout(cfg.dropout, deterministic=deterministic)(x)
 
@@ -246,8 +252,16 @@ class LLM(nn.Module):
             # unused — as in the trainer, which takes only `loss` — XLA
             # dead-code-eliminates this matmul.
             logits = tkn_emb.attend(x)
-        else:
+        elif logits_idx is None:
             logits = tkn_emb.attend(x[:, -1:, :])  # last position only (:694)
+            loss = None
+        else:
+            # bucketed prefill: each sequence's true last token sits at its
+            # own row of the right-padded buffer
+            sel = jnp.take_along_axis(
+                x, jnp.reshape(logits_idx, (-1, 1, 1)).astype(jnp.int32),
+                axis=1)
+            logits = tkn_emb.attend(sel)           # (B, 1, V)
             loss = None
 
         return logits, loss, new_caches
@@ -258,10 +272,11 @@ def init_cache(config: LLMConfig, batch_size: int,
     """Create the per-layer static KV-cache pytree for decoding.
 
     `dtype` should match the model's compute_dtype (fp32 default mirrors
-    LLM's; pass bfloat16 for bf16 inference). Decoding past `max_len` is
-    the caller's responsibility to prevent (XLA clamps out-of-range
-    dynamic_update_slice starts rather than erroring) — `generate` trims
-    with a sliding window before that point, like reference model.py:711-730.
+    LLM's; pass bfloat16 for bf16 inference). The buffers are RINGS under
+    traced positions (models/attention.py `_update_cache`): decoding past
+    `max_len` overwrites the oldest slot in O(1) — the static-shape
+    equivalent of the reference's trim-to-block_size-1 sliding window
+    (model.py:711-730), without the legacy roll's O(S) shift per token.
     """
     max_len = max_len or config.block_size
     return [init_attn_cache(config, batch_size, max_len, dtype)

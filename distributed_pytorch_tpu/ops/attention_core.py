@@ -10,8 +10,13 @@ single-gpu/model.py:149). Implementations:
 * 'pallas' — hand-written TPU flash-attention kernel (ops/flash_attention.py),
              blockwise online softmax in VMEM.
 * 'naive'  — explicit einsum path; supports attention-weight dropout, KV-cache
-             offset masks, and arbitrary masks. Used for decode steps and as
-             the reference semantics oracle in tests.
+             offset masks (scalar or per-sequence arrays), and arbitrary
+             masks. The decode fallback and the reference semantics oracle
+             in tests.
+* decode fast path — single-token KV-cached calls route to the split-KV
+             Pallas flash-decode kernel (ops/flash_decode.py) when
+             `flash_decode_usable` holds (FLASH_DECODE=auto|on|off;
+             'auto' = TPU only), else fall through to 'naive'.
 * 'auto'   — pallas on TPU when shapes allow, else xla. dropout>0 routes
              to the pallas kernel's IN-KERNEL dropout on TPU (round 5 —
              parity with CUDA SDPA dropout, reference model.py:149-151);
@@ -85,7 +90,9 @@ def _naive_sdpa(q, k, v, *, scale, q_offset, dropout_rate=0.0,
     """Reference-semantics einsum attention with cache-offset causal mask.
 
     Mask matches reference model.py:225-226: query global position =
-    q_offset + i may attend key positions j <= q_offset + i.
+    q_offset + i may attend key positions j <= q_offset + i. `q_offset`
+    may be a per-sequence (B,) array (slot-based ragged decode: each
+    sequence in the batch sits at its own cache position).
     """
     B, T, nh, hs = q.shape
     S, nkv = k.shape[1], k.shape[2]
@@ -97,10 +104,11 @@ def _naive_sdpa(q, k, v, *, scale, q_offset, dropout_rate=0.0,
     kf = k.astype(jnp.float32)
     attn = jnp.einsum("btnh,bsnh->bnts", qf, kf) * scale
     if causal:
-        qpos = q_offset + jnp.arange(T)[:, None]
-        kpos = jnp.arange(S)[None, :]
-        mask = qpos >= kpos  # (T, S)
-        attn = jnp.where(mask[None, None], attn, -jnp.inf)
+        qpos = (jnp.reshape(jnp.asarray(q_offset, jnp.int32), (-1, 1, 1))
+                + jnp.arange(T)[None, :, None])     # (B|1, T, 1)
+        kpos = jnp.arange(S)[None, None, :]
+        mask = qpos >= kpos  # (B|1, T, S)
+        attn = jnp.where(mask[:, None], attn, -jnp.inf)
     attn = jax.nn.softmax(attn, axis=-1)
     if dropout_rate > 0.0 and dropout_rng is not None:
         keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, attn.shape)
@@ -136,6 +144,29 @@ def sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                          "'zigzag' | 'ulysses'")
 
     use_dropout = dropout_rate > 0.0 and dropout_rng is not None
+
+    # KV-cached single-token decode: the memory-bound fast path. The
+    # split-KV Pallas kernel (ops/flash_decode.py) streams each sequence's
+    # VALID cache rows exactly once (per-sequence cache_len scalar-prefetch
+    # skips dead slots entirely) instead of the naive einsum's full-buffer
+    # read + per-query-head K/V repeat. Same degrade-don't-crash contract
+    # as loss_impl='pallas': the usable gate falls back to the naive path.
+    if (decode and causal and q.shape[1] == 1 and not use_dropout
+            and impl in ("auto", "pallas", "xla")):
+        from distributed_pytorch_tpu.ops.flash_decode import (
+            decode_mode, flash_decode, flash_decode_usable)
+        mode = decode_mode()
+        if (mode == "on" or (mode == "auto" and _on_tpu())) \
+                and flash_decode_usable(q, k, v):
+            # valid rows per sequence: the query's global position + 1,
+            # capped at the buffer length (ring cache wrapped)
+            cl = jnp.minimum(
+                jnp.reshape(jnp.asarray(q_offset, jnp.int32), (-1,)) + 1,
+                k.shape[1])
+            cl = jnp.broadcast_to(cl, (q.shape[0],))
+            out = flash_decode(q[:, 0], k, v, cl, scale=scale,
+                               interpret=not _on_tpu())
+            return out[:, None]
 
     # Sequence parallelism: when the ambient mesh (parallel/context.py) has
     # a live 'seq' axis and shapes allow, full-sequence causal attention

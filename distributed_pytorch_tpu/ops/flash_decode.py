@@ -1,0 +1,202 @@
+"""Pallas TPU split-KV flash-decode: single-query-per-sequence attention.
+
+Decode attention is memory-bound: one query row per sequence against an
+(S, n_kv, hs) cache — arithmetic intensity ~1 FLOP/byte, so the only
+number that matters is how few bytes move and how well the move overlaps.
+The naive einsum path (ops/attention_core.py `_naive_sdpa`, "Used for
+decode steps") materializes repeated K/V per GQA query head, computes a
+(B, nh, 1, S) f32 score tensor in HBM, and always streams the FULL cache
+buffer even when a sequence occupies three rows of a 1024-slot cache.
+
+This kernel is the flash-decode treatment (split-KV, cf. the
+FlashAttention decoding variant and the TPU serving stacks' ragged
+single-token attention):
+
+* **Split-KV grid**: grid (B, S/block_s) with the KV length split across
+  grid steps; the online-softmax state (running max m, normalizer l, f32
+  accumulator) lives in VMEM scratch that persists across the kv
+  dimension, exactly like the training kernel (ops/flash_attention.py) —
+  attention probabilities never exist in HBM.
+* **GQA head packing**: the query is reshaped (B, nh, hs) ->
+  (B, n_kv, rep, hs), so each kv head's `rep = nh/n_kv` query heads sit
+  in the SUBLANE dimension of one (rep, hs) x (hs, block_s) MXU tile —
+  K/V are read once per kv head, never materialized per query head.
+* **Per-sequence `cache_len` scalar-prefetch**
+  (`pltpu.PrefetchScalarGridSpec`, same idiom as the grouped-matmul
+  dispatch's tile->expert map): the (B,) valid-length vector is in SMEM
+  before the body runs, so grid steps past a sequence's last valid block
+  are predicated off with `pl.when` AND their kv index map clamps to the
+  last visible block — the revolving-buffer DMA sees an unchanged index
+  and issues no fetch. A sequence three tokens into a 1024-slot cache
+  costs one grid step, not eight: padded slots cost zero compute and
+  zero HBM traffic.
+* The last partial block masks `kpos >= cache_len` to a large negative
+  (NaN-free) before the max/sum update.
+
+Contract (mirrors `loss_impl='pallas'` / `grouped_usable` /
+`flash_attention_usable`): gate with `flash_decode_usable` first; callers
+fall back to the naive path — identical semantics, more HBM traffic —
+never to a crash. `FLASH_DECODE=auto|on|off` (read per call, so tests can
+flip it): 'auto' uses the kernel on TPU only, 'on' forces it (interpret
+mode off-TPU — the CPU parity tests), 'off' pins the naive path.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from distributed_pytorch_tpu.compat import tpu_compiler_params
+
+# KV-length tile (lane dimension of the score tiles). Env knob so
+# `mfu_sweep --variants decode` can ablate it per subprocess, like
+# FLASH_BLOCK_* / GMM_BLOCK_*.
+DEFAULT_BLOCK_S = int(os.environ.get("FLASH_DECODE_BLOCK", "512"))
+
+_NEG_INF = -1e30  # large-negative instead of -inf: keeps masked rows NaN-free
+
+# one grid step's buffers: double-buffered kv tiles + f32 scratch + scores
+_VMEM_BUDGET = int(os.environ.get("FLASH_VMEM_BUDGET_MB", "64")) * 2 ** 20
+
+
+def decode_mode() -> str:
+    """'auto' | 'on' | 'off' — read per call (tests monkeypatch env)."""
+    return os.environ.get("FLASH_DECODE", "auto")
+
+
+def _pick_block(n: int, preferred: int, step: int) -> int:
+    """Largest divisor of n that is <= preferred and a multiple of `step`;
+    0 when none exists (gate then declines)."""
+    b = min(preferred, n)
+    b -= b % step
+    while b > step and n % b != 0:
+        b -= step
+    return b if (b >= step and n % b == 0) else 0
+
+
+def _kernel(cl_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
+            scale: float, block_s: int):
+    b, j = pl.program_id(0), pl.program_id(1)
+    n = cl_ref[b]
+    last_j = jax.lax.div(jnp.maximum(n, 1) - 1, block_s)
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    @pl.when(j <= last_j)
+    def _():
+        q = q_ref[0]                            # (nkv, rep, hs)
+        # cache tiles arrive in the model's natural (block_s, nkv, hs)
+        # layout; relayout head-major in VMEM (the slab-kernel trick —
+        # no HBM transpose of the big cache buffers)
+        k = k_ref[0].transpose(1, 0, 2)         # (nkv, block_s, hs)
+        v = v_ref[0].transpose(1, 0, 2)
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale  # (nkv, rep, bs) f32
+        kpos = j * block_s + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(kpos < n, s, _NEG_INF)
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
+                    ).astype(o_ref.dtype)
+
+
+def flash_decode(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                 cache_len: jnp.ndarray, *, scale: float,
+                 block_s: int = 0, interpret: bool = False) -> jnp.ndarray:
+    """Single-token cached attention: q (B, nh, hs) against k/v
+    (B, S, n_kv, hs) cache buffers with per-sequence valid lengths
+    `cache_len` (B,) int32 (rows [0, cache_len) are attended; the rest are
+    dead slots). Returns (B, nh, hs). Gate with `flash_decode_usable`."""
+    B, nh, hs = q.shape
+    S, nkv = k.shape[1], k.shape[2]
+    rep = nh // nkv
+    block_s = block_s or _pick_block(S, DEFAULT_BLOCK_S,
+                                     8 if interpret else 128)
+    assert block_s and S % block_s == 0, (
+        f"no usable KV split for S={S} — gate with flash_decode_usable")
+
+    cl = jnp.asarray(cache_len, jnp.int32).reshape(B)
+    q4 = q.reshape(B, nkv, rep, hs)
+
+    def q_idx(b, j, cl_ref):
+        return (b, 0, 0, 0)
+
+    def kv_idx(b, j, cl_ref):
+        # clamp skipped blocks to the sequence's last visible one: the
+        # revolving buffer sees an unchanged index -> no DMA for dead slots
+        last = jax.lax.div(jnp.maximum(cl_ref[b], 1) - 1, block_s)
+        return (b, jnp.minimum(j, last), 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, S // block_s),
+        in_specs=[
+            pl.BlockSpec((1, nkv, rep, hs), q_idx),
+            pl.BlockSpec((1, block_s, nkv, hs), kv_idx),
+            pl.BlockSpec((1, block_s, nkv, hs), kv_idx),
+        ],
+        out_specs=pl.BlockSpec((1, nkv, rep, hs), q_idx),
+        scratch_shapes=[
+            pltpu.VMEM((nkv, rep, hs), jnp.float32),
+            pltpu.VMEM((nkv, rep, 1), jnp.float32),
+            pltpu.VMEM((nkv, rep, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, scale=float(scale), block_s=block_s),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, nkv, rep, hs), q.dtype),
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(cl, q4, k, v)
+    return out.reshape(B, nh, hs)
+
+
+def flash_decode_usable(q, k, v) -> bool:
+    """Static gate for the dispatcher: (B, 1, nh, hs)-shaped decode query,
+    dtypes/shapes the kernel tiles, no live multi-device mesh (GSPMD
+    cannot partition a pallas_call; a shard_map wrap over 'data' is future
+    work — the naive path handles sharded decode meanwhile)."""
+    if q.ndim != 4 or q.shape[1] != 1:
+        return False
+    B, _, nh, hs = q.shape
+    S, nkv = k.shape[1], k.shape[2]
+    if q.dtype not in (jnp.float32, jnp.bfloat16):
+        return False
+    if hs % 8 != 0 or nh % nkv != 0:
+        return False
+    on_tpu = jax.default_backend() == "tpu"
+    block_s = _pick_block(S, DEFAULT_BLOCK_S, 128 if on_tpu else 8)
+    if not block_s:
+        return False
+    from distributed_pytorch_tpu.parallel import context
+    mesh = context.get_mesh()
+    if mesh is not None and any(s > 1 for s in mesh.devices.shape):
+        return False
+    dsize = jnp.dtype(q.dtype).itemsize
+    rep = nh // nkv
+    tiles = 2 * 2 * block_s * nkv * hs * dsize          # double-buffered k+v
+    scratch = nkv * rep * (hs + 2) * 4
+    scores = 3 * nkv * rep * block_s * 4                # s, p, mask temps
+    return tiles + scratch + scores <= _VMEM_BUDGET

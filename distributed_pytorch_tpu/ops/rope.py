@@ -31,12 +31,20 @@ def precompute_rope_freqs(dim: int, max_seq_len: int, base: float = 10000.0,
 
 
 def slice_rows(table: jnp.ndarray, pos, length: int) -> jnp.ndarray:
-    """table[pos : pos+length] along axis 0, supporting traced `pos` (KV-cached
-    decode) as well as the static pos==0 fast path. Shared by RoPE freq /
-    positional-embedding lookups."""
+    """table[pos : pos+length] along axis 0, supporting traced `pos`
+    (KV-cached decode), a per-sequence (B,) position array (slot-based
+    ragged decode — returns a leading batch axis, (B, length, ...)), and
+    the static pos==0 fast path. Shared by RoPE freq / positional-embedding
+    lookups. Out-of-table positions clamp to the last row
+    (dynamic_slice semantics) — the sliding-window behavior once the ring
+    cache wraps past the table."""
     import jax
     if isinstance(pos, int) and pos == 0:
         return table[:length]
+    pos = jnp.asarray(pos)
+    if pos.ndim == 1:
+        return jax.vmap(lambda p: jax.lax.dynamic_slice_in_dim(
+            table, p, length, axis=0))(pos)
     return jax.lax.dynamic_slice_in_dim(table, pos, length, axis=0)
 
 
@@ -45,14 +53,20 @@ def apply_rotary_emb(x: jnp.ndarray, freqs: jnp.ndarray) -> jnp.ndarray:
 
     x: (B, T, H, hs); freqs: (T, hs//2, 2) slice of the precomputed table
     (caller slices [start_pos : start_pos+T] for KV-cached decoding, like
-    reference model.py:660). Computation in fp32, cast back to x.dtype
-    (matching reference `x.float()` ... `type_as(x)`).
+    reference model.py:660), or a per-sequence (B, T, hs//2, 2) slice when
+    sequences in the batch sit at different positions (slot-based ragged
+    decode). Computation in fp32, cast back to x.dtype (matching reference
+    `x.float()` ... `type_as(x)`).
     """
     B, T, H, hs = x.shape
     xf = x.astype(jnp.float32).reshape(B, T, H, hs // 2, 2)
     x_re, x_im = xf[..., 0], xf[..., 1]
-    cos = freqs[None, :, None, :, 0]  # (1, T, 1, hs//2)
-    sin = freqs[None, :, None, :, 1]
+    if freqs.ndim == 4:               # per-sequence rows
+        cos = freqs[:, :, None, :, 0]  # (B, T, 1, hs//2)
+        sin = freqs[:, :, None, :, 1]
+    else:
+        cos = freqs[None, :, None, :, 0]  # (1, T, 1, hs//2)
+        sin = freqs[None, :, None, :, 1]
     out_re = x_re * cos - x_im * sin
     out_im = x_re * sin + x_im * cos
     out = jnp.stack([out_re, out_im], axis=-1).reshape(B, T, H, hs)

@@ -240,6 +240,25 @@ def moe_dispatch_specs() -> tuple[P, P, P]:
     return tok, w, P("data", None)
 
 
+def decode_cache_pspec(shape: tuple[int, ...], mesh: Mesh) -> P:
+    """PartitionSpec for one decode KV-cache buffer (engine.DecodeEngine).
+
+    GQA buffers (B_slots, S, n_kv, hs) shard the kv-head axis over 'model'
+    (the megatron layout: the qkv projection already emits head-sharded
+    activations under tp, so cache reads/writes stay local) and the slot
+    axis over 'data'; MLA latent buffers (B_slots, S, latent[, dhr])
+    have no head axis — slots over 'data' only. One definition here so the
+    engine's cache layout cannot drift from the recipe tables above."""
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    axes: list[Optional[str]] = [None] * len(shape)
+    if (len(shape) == 4 and sizes.get("model", 1) > 1
+            and shape[2] % sizes["model"] == 0 and shape[2] > 1):
+        axes[2] = "model"
+    if sizes.get("data", 1) > 1 and shape[0] % sizes["data"] == 0:
+        axes[0] = "data"
+    return P(*axes)
+
+
 def named(mesh: Mesh, spec_tree: Any) -> Any:
     """PartitionSpec pytree -> NamedSharding pytree."""
     return jax.tree_util.tree_map(

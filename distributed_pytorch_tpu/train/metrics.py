@@ -43,6 +43,56 @@ def peak_flops_per_chip() -> float | None:
     return None
 
 
+# Peak HBM bandwidth (bytes/s) per chip, same spec-sheet sourcing as
+# _PEAK_FLOPS. Decode is memory-bound, so its utilization metric is MBU
+# (memory-bandwidth utilization), not MFU.
+_PEAK_HBM_BW = (
+    ("v6", 1.64e12),       # Trillium
+    ("v5p", 2.765e12),
+    ("v5", 8.19e11),       # v5e
+    ("v4", 1.228e12),
+    ("v3", 9.0e11),
+    ("v2", 7.0e11),
+)
+
+
+def peak_hbm_bw_per_chip() -> float | None:
+    try:
+        kind = jax.devices()[0].device_kind.lower()
+    except Exception:  # pragma: no cover
+        return None
+    for key, val in _PEAK_HBM_BW:
+        if key in kind:
+            return val
+    return None
+
+
+def kv_bytes_per_token(cfg: LLMConfig, cache_dtype_size: int = 2) -> int:
+    """Bytes of KV cache one token occupies across all layers (GQA: 2
+    (k+v) * n_kv heads * head_size; MLA: the compressed latent [+ the
+    shared rotary key head])."""
+    if cfg.attn in ("mha", "mqa", "gqa"):
+        row = 2 * cfg.n_kv_heads * cfg.head_size
+    else:
+        row = cfg.kv_latent_dim + (cfg.rope_head_dim
+                                   if cfg.pos_emb == "rope" else 0)
+    return cfg.n_layer * row * cache_dtype_size
+
+
+def decode_step_bytes(cfg: LLMConfig, batch: int, cache_len: int,
+                      param_dtype_size: int = 2,
+                      cache_dtype_size: int = 2) -> int:
+    """Bytes-moved model for ONE batched decode step: every matmul
+    parameter is read once (decode is weight-bandwidth-bound; the batch
+    amortizes this read — why the engine batches ragged slots), each
+    sequence's valid KV rows are read once, and one new row is written.
+    Activations (B rows of C floats) are noise and excluded. Divide by
+    (step time x peak_hbm_bw_per_chip) for MBU."""
+    params = matmul_params_per_token(cfg) * param_dtype_size
+    kv = batch * (cache_len + 1) * kv_bytes_per_token(cfg, cache_dtype_size)
+    return params + kv
+
+
 def attn_matmul_params_per_token(cfg: LLMConfig) -> int:
     """Matmul parameters of the attention sublayer per token (per ALL
     layers) — the recompute cost of the attention-only remat policy."""

@@ -30,9 +30,75 @@ from distributed_pytorch_tpu.train.state import create_train_state
 from distributed_pytorch_tpu.train.step import make_train_step
 
 
+def _time_decode(slots: int, iters: int) -> dict:
+    """Isolated fused decode step (round 8): `slots` sequences advance one
+    token against a half-full slot cache. Decode is memory-bound, so the
+    utilization column is MBU — bytes-moved model (params read once per
+    step + valid KV rows, train/metrics.decode_step_bytes) over the chip's
+    peak HBM bandwidth — printed where the train variants print MFU.
+    FLASH_DECODE / FLASH_DECODE_BLOCK env knobs A/B the split-KV kernel
+    against the naive einsum path per subprocess."""
+    import jax.numpy as jnp
+
+    from distributed_pytorch_tpu.config import PRESETS
+    from distributed_pytorch_tpu.models.gpt import LLM, init_cache
+
+    preset = os.environ.get("SWEEP_PRESET", "gpt2_124m")
+    cfg = PRESETS[preset]()
+    dtype = jnp.bfloat16
+    model = LLM(cfg, compute_dtype=dtype, attn_impl="auto")
+    rng = jax.random.PRNGKey(0)
+    dummy = jnp.zeros((1, cfg.block_size), jnp.int32)
+    variables = jax.jit(model.init)({"params": rng, "dropout": rng},
+                                    dummy, dummy)
+    S = cfg.block_size
+    cache_len = S // 2
+    caches = init_cache(cfg, slots, S, dtype=dtype)
+    pos = jnp.full((slots,), cache_len, jnp.int32)
+    tok = jnp.zeros((slots,), jnp.int32)
+
+    @jax.jit
+    def step(variables, caches, tok, pos):
+        logits, _, caches = model.apply(variables, tok[:, None], None,
+                                        caches, pos, deterministic=True)
+        nxt = jnp.argmax(logits[:, -1, :], -1).astype(jnp.int32)
+        return caches, nxt, pos + 1
+
+    caches, tok, pos = step(variables, caches, tok, pos)  # compile + warmup
+    jax.device_get(tok)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        caches, tok, pos = step(variables, caches, tok, pos)
+    jax.device_get(tok)  # metrics-fetch sync (see time_variant note)
+    dt = (time.perf_counter() - t0) / iters
+    dsz = jnp.dtype(dtype).itemsize
+    bts = M.decode_step_bytes(cfg, slots, cache_len + iters // 2, dsz, dsz)
+    bw = M.peak_hbm_bw_per_chip()
+    mbu = bts / dt / bw if bw else float("nan")
+    flash = os.environ.get("FLASH_DECODE", "auto")
+    blk = os.environ.get("FLASH_DECODE_BLOCK", "512")
+    print(f"decode slots={slots:4d} cache={cache_len:5d} flash={flash:4s} "
+          f"block={blk:>4s} | {dt * 1e3:7.2f} ms/step | "
+          f"{slots / dt:9.0f} tok/s | mbu {mbu:6.2%} | "
+          f"{bts / 2 ** 20:6.0f} MiB/step [{preset}]", flush=True)
+    return {"decode": True, "slots": slots, "ms": dt * 1e3, "mbu": mbu,
+            "flash_decode": flash, "block": blk, "preset": preset}
+
+
 def time_variant(batch: int, attn_impl: str, act_recomp: bool,
                  loss_impl: str, iters: int) -> dict | None:
     import os as _os
+    if _os.environ.get("SWEEP_DECODE"):
+        # decode leg: `batch` is the slot count; attn/remat/loss unused
+        try:
+            return _time_decode(batch, iters)
+        except Exception as e:  # noqa: BLE001 — report like train variants
+            print(f"decode slots={batch} FAILED: {type(e).__name__}: "
+                  f"{str(e)[:120]}", flush=True)
+            if any(s in str(e) for s in ("Out of memory", "VMEM", "vmem",
+                                         "exceeds available")):
+                sys.exit(3)
+            return None
 
     from distributed_pytorch_tpu.config import PRESETS
     # per-subprocess env knobs (like FLASH_BLOCK_*): SWEEP_PRESET picks the
@@ -246,6 +312,25 @@ def main():
             (16, "xla", False, "fused", {"SWEEP_MOE": "grouped",
                                          "SWEEP_RECIPE": "ep",
                                          "SWEEP_EP": "2"}),
+        ]
+    elif args.variants == "decode":
+        # flash-decode vs naive A/B inside the isolated fused decode step
+        # (round 8): slot-count scaling (decode amortizes the weight read
+        # over slots), split-KV tile ablation, and a ladder rung. The
+        # printed column is MBU (memory-bandwidth utilization), not MFU.
+        D = {"SWEEP_DECODE": "1"}
+        grid = [
+            (8, "auto", False, "fused", {**D, "FLASH_DECODE": "off"}),
+            (8, "auto", False, "fused", {**D, "FLASH_DECODE": "on"}),
+            (32, "auto", False, "fused", {**D, "FLASH_DECODE": "off"}),
+            (32, "auto", False, "fused", {**D, "FLASH_DECODE": "on"}),
+            (32, "auto", False, "fused", {**D, "FLASH_DECODE": "on",
+                                          "FLASH_DECODE_BLOCK": "256"}),
+            (32, "auto", False, "fused", {**D, "FLASH_DECODE": "on",
+                                          "FLASH_DECODE_BLOCK": "1024"}),
+            (128, "auto", False, "fused", {**D, "FLASH_DECODE": "on"}),
+            (8, "auto", False, "fused", {**D, "FLASH_DECODE": "on",
+                                         "SWEEP_PRESET": "gpt2_350m"}),
         ]
     elif args.variants == "ladder":
         # the 350M-1.5B rungs (BASELINE.json): batch/remat per the static

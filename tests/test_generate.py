@@ -74,7 +74,7 @@ def test_cached_greedy_matches_full_recompute(kw):
 def test_sliding_window_generates_past_cache():
     """Decoding past the cache size must not crash and must keep producing
     in-vocab tokens (reference trims caches to block_size-1,
-    model.py:711-730; here the buffers roll)."""
+    model.py:711-730; here the ring write overwrites the oldest slot)."""
     cfg = tiny_cfg(attn="mha", pos_emb="rope", block_size=16)
     model, variables = build(cfg)
     prompt = jnp.array([[1, 2, 3]], jnp.int32)
@@ -123,6 +123,68 @@ def test_generate_fn_reuse_and_batching():
     assert out1.shape == (3, 10)
     # greedy: rng must not matter
     assert (out1 == out2).all()
+
+
+@pytest.mark.parametrize("kw", [
+    dict(attn="mha", pos_emb="rope"),
+    dict(attn="gqa", n_kv_heads=2, pos_emb="learn"),
+    dict(attn="mqa", pos_emb="sin"),
+], ids=["mha-rope", "gqa-learn", "mqa-sin"])
+def test_flash_decode_greedy_matches_oracle(kw, monkeypatch):
+    """Greedy decode with the split-KV flash-decode kernel forced on
+    (interpret mode on CPU) is token-identical to the teacher-forced
+    full-recompute argmax AND to the naive decode path — the end-to-end
+    acceptance check for ops/flash_decode.py."""
+    monkeypatch.setenv("FLASH_DECODE", "on")
+    cfg = tiny_cfg(**kw)
+    model = LLM(cfg, attn_impl="auto")  # 'naive' would pin the oracle path
+    rng = jax.random.PRNGKey(0)
+    x = jnp.zeros((1, cfg.block_size), jnp.int32)
+    variables = model.init({"params": rng, "dropout": rng}, x, x)
+    prompt = jax.random.randint(jax.random.PRNGKey(9), (2, 5), 0,
+                                cfg.vocab_size, jnp.int32)
+    out = generate(model, variables, prompt, 8, temperature=0.0)
+    ref = greedy_oracle(model, variables, prompt, 8)
+    assert (out == ref).all(), f"flash-decode diverged from oracle for {kw}"
+    monkeypatch.setenv("FLASH_DECODE", "off")
+    naive = generate(model, variables, prompt, 8, temperature=0.0)
+    assert (out == naive).all()
+
+
+def test_bucketed_prompt_len_matches_unpadded():
+    """Right-padded bucketed prompts (`prompt_len`) decode the same tokens
+    as the exact-shape call: pad rows are causally invisible and the
+    per-sequence positions pick up from each row's true length."""
+    cfg = tiny_cfg()
+    model, variables = build(cfg)
+    lens = [3, 7, 5]
+    bucket = 8
+    rows = [list(range(1, L + 1)) for L in lens]
+    padded = jnp.asarray([r + [0] * (bucket - len(r)) for r in rows],
+                         jnp.int32)
+    gen = make_generate_fn(model, 6, temperature=0.0)
+    out = gen(variables, padded, jax.random.PRNGKey(0),
+              jnp.asarray(lens, jnp.int32))
+    for i, (r, L) in enumerate(zip(rows, lens)):
+        ref = generate(model, variables, jnp.asarray(r, jnp.int32)[None], 6,
+                       temperature=0.0)[0].tolist()
+        got = out[i].tolist()
+        got = got[:L] + got[bucket:]  # splice out the pad tail
+        assert got == ref, f"row {i} (len {L}) diverged under padding"
+
+
+def test_prompt_len_full_rows_match_plain_call():
+    """prompt_len == T0 for every row must reproduce the plain
+    (no prompt_len) greedy decode exactly."""
+    cfg = tiny_cfg()
+    model, variables = build(cfg)
+    p = jax.random.randint(jax.random.PRNGKey(3), (2, 6), 0, cfg.vocab_size,
+                           jnp.int32)
+    gen = make_generate_fn(model, 5, temperature=0.0)
+    plain = gen(variables, p, jax.random.PRNGKey(1))
+    ragged = gen(variables, p, jax.random.PRNGKey(1),
+                 jnp.full((2,), 6, jnp.int32))
+    assert (plain == ragged).all()
 
 
 def test_sharded_sampling_cli(tmp_path, monkeypatch, capsys):

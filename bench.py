@@ -143,8 +143,14 @@ def _decode_bench(platform: str) -> dict:
     dummy = jnp.zeros((1, cfg.block_size), jnp.int32)
     variables = jax.jit(model.init)({"params": rng, "dropout": rng},
                                     dummy, dummy)
+    # quantized-serving knobs (round 9): BENCH_CACHE_DTYPE=int8 quantizes
+    # the KV cache, BENCH_QUANT_W=1 the decode weights — the decode_int8
+    # A/B leg vs the bf16 decode_flash/decode_naive legs
+    cache_dtype = os.environ.get("BENCH_CACHE_DTYPE", "") or None
+    quant_w = os.environ.get("BENCH_QUANT_W", "") == "1"
     eng = DecodeEngine(model, variables, n_slots=slots, max_len=S,
-                       temperature=1.0, top_k=50)
+                       temperature=1.0, top_k=50,
+                       cache_dtype=cache_dtype, quantize_weights=quant_w)
 
     prompt_len = S // 2
     npr = np.random.default_rng(0)
@@ -171,12 +177,16 @@ def _decode_bench(platform: str) -> dict:
     dt = time.perf_counter() - t0
     steady = slots * iters / dt
 
-    # MBU from the bytes-moved model at the window's mean cache length
+    # MBU from the bytes-moved model at the window's mean cache length,
+    # priced at the TRUE per-tensor itemsizes (int8 cache = 1 byte + its
+    # f32 scale sidecars; quantized weights = 1 byte + per-channel scales)
     mean_len = prompt_len + 1 + iters // 2
     bw = M.peak_hbm_bw_per_chip()
+    cache_size = jnp.dtype(eng.cache_dtype).itemsize
     bytes_step = M.decode_step_bytes(cfg, slots, mean_len,
                                      param_dtype_size=jnp.dtype(dtype).itemsize,
-                                     cache_dtype_size=jnp.dtype(dtype).itemsize)
+                                     cache_dtype_size=cache_size,
+                                     quant_weights=eng.weights_quantized)
     mbu = (bytes_step * iters / dt) / (bw * n_dev) if bw else None
 
     # ragged window: drain the full slots with random budgets via fresh
@@ -213,6 +223,8 @@ def _decode_bench(platform: str) -> dict:
             "mbu": round(mbu, 4) if mbu is not None else None,
             "n_slots": slots, "cache_len": S,
             "flash_decode": os.environ.get("FLASH_DECODE", "auto"),
+            "cache_dtype": jnp.dtype(eng.cache_dtype).name,
+            "quant_w": eng.weights_quantized,
             "n_chips": n_dev, "device": jax.devices()[0].device_kind,
             "preset": preset}
 
@@ -507,7 +519,16 @@ def main() -> None:
                     ("decode_flash", {"BENCH_DECODE": "1",
                                       "FLASH_DECODE": "on"}),
                     ("decode_naive", {"BENCH_DECODE": "1",
-                                      "FLASH_DECODE": "off"})]:
+                                      "FLASH_DECODE": "off"}),
+                    # round 9: quantized serving — int8 KV (in-kernel
+                    # dequant) + weight-only int8 decode vs the bf16 legs
+                    ("decode_int8", {"BENCH_DECODE": "1",
+                                     "FLASH_DECODE": "on",
+                                     "BENCH_CACHE_DTYPE": "int8",
+                                     "BENCH_QUANT_W": "1"}),
+                    ("decode_int8_kv", {"BENCH_DECODE": "1",
+                                        "FLASH_DECODE": "on",
+                                        "BENCH_CACHE_DTYPE": "int8"})]:
                 r = _spawn_worker("tpu", timeout_s=900, extra_env=env)
                 if r:
                     decode_results[name] = r

@@ -74,6 +74,12 @@ class DecodeEngine:
     >>> eng = DecodeEngine(model, variables, n_slots=8, temperature=0.0)
     >>> outs = eng.run(prompts, max_new_tokens=64)   # list of token lists
 
+    Quantized serving (ops/quant.py): `cache_dtype='int8'` quantizes the
+    KV cache on the ring write (flash-decode dequantizes in VMEM),
+    `quantize_weights=True` runs the decode matmuls on int8 codes +
+    per-output-channel scales while prefill keeps bf16 — together ~1.9x
+    fewer bytes per step at the bench decode shape (PERF.md round 9).
+
     or stream it yourself: `admit()` until `free_slots` is empty, then
     `step()` repeatedly — it returns `{seq_id: tokens}` for sequences that
     finished this step.
@@ -81,6 +87,7 @@ class DecodeEngine:
 
     def __init__(self, model, variables: dict, *, n_slots: int = 8,
                  max_len: Optional[int] = None, cache_dtype=None,
+                 quantize_weights: bool = False,
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  eos_id: Optional[int] = None, rng=None,
                  mesh=None, recipe: str = "single", min_bucket: int = 16):
@@ -90,7 +97,29 @@ class DecodeEngine:
         self.n_slots = n_slots
         self.max_len = max_len or cfg.block_size
         assert self.max_len <= cfg.block_size
-        self.cache_dtype = cache_dtype or model.compute_dtype
+        # Quantized serving knobs (ops/quant.py). cache_dtype='int8' (or
+        # jnp.int8) quantizes the KV cache on the ring write — int8 codes
+        # + f32 scale sidecars ride the cache pytree, the flash-decode
+        # kernel dequantizes in VMEM. quantize_weights=True quantizes the
+        # params once here; decode matmuls read int8 codes with the scale
+        # applied on the output, PREFILL keeps the bf16 originals. The
+        # QUANT_KV / QUANT_W env gates (auto|on|off) override both for
+        # bench/sweep A/B legs; `quant_kv_usable` degrades MLA to the
+        # compute dtype instead of crashing.
+        from distributed_pytorch_tpu.ops import quant
+        if cache_dtype is not None and not isinstance(cache_dtype, str):
+            cache_dtype = jnp.dtype(cache_dtype).name
+        want_kv = quant.resolve_gate(quant.kv_quant_mode(),
+                                     cache_dtype == "int8")
+        if want_kv and quant.quant_kv_usable(cfg):
+            self.cache_dtype = jnp.int8
+        elif cache_dtype and cache_dtype != "int8":
+            self.cache_dtype = jnp.dtype(cache_dtype)
+        else:
+            self.cache_dtype = model.compute_dtype
+        self.kv_quantized = self.cache_dtype == jnp.int8
+        self.weights_quantized = quant.resolve_gate(quant.weight_quant_mode(),
+                                                    quantize_weights)
         self.temperature = temperature
         self.top_k = top_k
         self.eos_id = eos_id
@@ -111,6 +140,16 @@ class DecodeEngine:
                     variables["moe_state"])
             variables = jax.device_put(variables, sh_tree)
         self.variables = variables
+
+        # weight-only int8: quantized once per engine (from the placed
+        # params, so shardings carry through); passed as an ARGUMENT to
+        # the jitted step — closing over concrete arrays would bake them
+        # into the executable as constants
+        self._qparams = None
+        if self.weights_quantized:
+            from distributed_pytorch_tpu.ops.quant import quantize_params
+            with self._ctx():
+                self._qparams = jax.jit(quantize_params)(variables["params"])
 
         caches = init_cache(cfg, n_slots, self.max_len,
                             dtype=self.cache_dtype)
@@ -155,11 +194,16 @@ class DecodeEngine:
         if self._step_fn is not None:
             return self._step_fn
 
-        def step(variables, caches, tok, pos, live, rng, t):
+        def step(variables, caches, tok, pos, live, rng, t, qparams):
             self.step_traces += 1  # python side effect: counts traces only
-            logits, _, caches = self.model.apply(
-                variables, tok[:, None], None, caches, pos,
-                deterministic=True)
+            from distributed_pytorch_tpu.ops.quant import use_quantized_params
+            with use_quantized_params(qparams):
+                # quantized weights (when a store is active): decode
+                # matmuls read int8 codes instead of the bf16 kernels —
+                # the unused bf16 leaves are pruned from the compiled step
+                logits, _, caches = self.model.apply(
+                    variables, tok[:, None], None, caches, pos,
+                    deterministic=True)
             nxt = self._sample(logits[:, -1, :], jax.random.fold_in(rng, t))
             # dead slots: freeze the token and position (their cache row
             # write lands on an already-masked slot; no cleanup needed)
@@ -269,7 +313,7 @@ class DecodeEngine:
         with self._ctx():
             self.caches, self.tok, self.pos = self._get_step_fn()(
                 self.variables, self.caches, self.tok, self.pos, self.live,
-                self._rng, jnp.int32(self._t))
+                self._rng, jnp.int32(self._t), self._qparams)
         self._t += 1
         sampled = jax.device_get(self.tok)
         done: dict[int, list] = {}

@@ -75,9 +75,16 @@ class _OverlapDense(nn.Module):
         bias = self.param("bias", nn.initializers.zeros,
                           (self.features,), jnp.float32)
         kd = kernel.astype(self.dtype)
-        from distributed_pytorch_tpu.ops.collective_matmul import (
-            maybe_overlap_matmul)
-        y = maybe_overlap_matmul(x, kd, names=(self.name, "kernel"))
+        # weight-only int8 decode (ops/quant.py): when the engine's step
+        # runs under use_quantized_params, the matmul reads int8 codes +
+        # per-output-channel scales instead of the bf16 kernel; everywhere
+        # else the lookup misses and nothing changes
+        from distributed_pytorch_tpu.ops.quant import maybe_quantized_matmul
+        y = maybe_quantized_matmul(x, (*self.path, "kernel"))
+        if y is None:
+            from distributed_pytorch_tpu.ops.collective_matmul import (
+                maybe_overlap_matmul)
+            y = maybe_overlap_matmul(x, kd, names=(self.name, "kernel"))
         if y is None:
             y = x @ kd
         return y + bias.astype(self.dtype)
@@ -142,24 +149,50 @@ class GQA(nn.Module):
 
         new_cache = None
         q_offset = 0
+        k_scale = v_scale = None
         if cache is not None:
-            k_buf = _update_cache(cache["k"], k, pos)
-            v_buf = _update_cache(cache["v"], v, pos)
-            new_cache = {"k": k_buf, "v": v_buf}
-            k, v = k_buf, v_buf
+            if "k_scale" in cache:
+                # int8 cache: quantize on the ring write — codes land in
+                # the int8 buffers, per-(row, kv-head) scales in the f32
+                # sidecars, all via the same O(1) slot writes
+                from distributed_pytorch_tpu.ops.quant import quantize_kv
+                k_q, k_s = quantize_kv(k)
+                v_q, v_s = quantize_kv(v)
+                k = _update_cache(cache["k"], k_q, pos)
+                v = _update_cache(cache["v"], v_q, pos)
+                k_scale = _update_cache(cache["k_scale"], k_s, pos)
+                v_scale = _update_cache(cache["v_scale"], v_s, pos)
+                new_cache = {"k": k, "k_scale": k_scale,
+                             "v": v, "v_scale": v_scale}
+            else:
+                k = _update_cache(cache["k"], k, pos)
+                v = _update_cache(cache["v"], v, pos)
+                new_cache = {"k": k, "v": v}
             q_offset = pos
 
         drop_rng = None
         if cfg.dropout > 0.0 and not deterministic:
             drop_rng = self.make_rng("dropout")
-        y = sdpa(q, k.astype(q.dtype), v.astype(q.dtype), causal=True,
-                 q_offset=q_offset, dropout_rate=cfg.dropout,
+        y = sdpa(q, k if k_scale is not None else k.astype(q.dtype),
+                 v if v_scale is not None else v.astype(q.dtype),
+                 causal=True, q_offset=q_offset, dropout_rate=cfg.dropout,
                  dropout_rng=drop_rng, impl=self.attn_impl,
-                 decode=cache is not None)
+                 decode=cache is not None, k_scale=k_scale, v_scale=v_scale)
         y = y.reshape(B, T, C)
         y = _OverlapDense(C, x.dtype, name="c_proj")(y)
         y = nn.Dropout(cfg.dropout, deterministic=deterministic)(y)
         return y, new_cache
+
+
+def _qmm(mod: nn.Module, x: jnp.ndarray, kernel: jnp.ndarray,
+         name: str) -> jnp.ndarray:
+    """`x @ kernel` with the weight-only-int8 store consulted first
+    (ops/quant.py): under an engine decode step with quantized params the
+    matmul reads int8 codes + per-output-channel scales; everywhere else
+    it is the plain cast-and-matmul."""
+    from distributed_pytorch_tpu.ops.quant import maybe_quantized_matmul
+    y = maybe_quantized_matmul(x, (*mod.path, name))
+    return y if y is not None else x @ kernel.astype(x.dtype)
 
 
 def _mla_kernels(mod: nn.Module, cfg: LLMConfig, C: int, *, rope: bool) -> dict:
@@ -243,9 +276,9 @@ class NaiveMLA(nn.Module):
         dt = x.dtype
 
         ks = _mla_kernels(self, cfg, C, rope=False)
-        q = (x @ ks["W_dq"].astype(dt)) @ ks["W_uq"].astype(dt)
+        q = _qmm(self, _qmm(self, x, ks["W_dq"], "W_dq"), ks["W_uq"], "W_uq")
         q = q.reshape(B, T, nh, hs)
-        new_c_kv = x @ ks["W_dkv"].astype(dt)  # (B, T, nlkv)
+        new_c_kv = _qmm(self, x, ks["W_dkv"], "W_dkv")  # (B, T, nlkv)
 
         if cache is None:
             # Training/full-sequence: materialize per-head K/V -> fused SDPA.
@@ -261,10 +294,14 @@ class NaiveMLA(nn.Module):
         else:
             c_kv = _update_cache(cache["c_kv"], new_c_kv, pos)
             new_cache = {"c_kv": c_kv}
-            y = _absorbed_decode(q, c_kv, ks["W_uk"], ks["W_uv"], pos,
+            from distributed_pytorch_tpu.ops.quant import \
+                maybe_dequantized_param
+            kuk = maybe_dequantized_param((*self.path, "W_uk"), ks["W_uk"])
+            kuv = maybe_dequantized_param((*self.path, "W_uv"), ks["W_uv"])
+            y = _absorbed_decode(q, c_kv, kuk, kuv, pos,
                                  1.0 / jnp.sqrt(float(hs)))
 
-        y = y @ ks["W_o"].astype(dt)
+        y = _qmm(self, y, ks["W_o"], "W_o")
         y = nn.Dropout(cfg.dropout, deterministic=deterministic)(y)
         return y, new_cache
 
@@ -295,12 +332,13 @@ class FullMLA(nn.Module):
         ks = _mla_kernels(self, cfg, C, rope=True)
         f = slice_rows(freqs, pos, T)
 
-        c_q = x @ ks["W_dq"].astype(dt)                            # (B,T,nlq)
-        q_c = (c_q @ ks["W_uq"].astype(dt)).reshape(B, T, nh, hs)  # content q
+        c_q = _qmm(self, x, ks["W_dq"], "W_dq")                    # (B,T,nlq)
+        q_c = _qmm(self, c_q, ks["W_uq"], "W_uq").reshape(B, T, nh, hs)
         q_r = apply_rotary_emb(
-            (c_q @ ks["W_qr"].astype(dt)).reshape(B, T, nh, dhr), f)
-        new_c_kv = x @ ks["W_dkv"].astype(dt)                      # (B,T,nlkv)
-        new_k_r = apply_rotary_emb((x @ ks["W_kr"].astype(dt))[:, :, None, :], f)
+            _qmm(self, c_q, ks["W_qr"], "W_qr").reshape(B, T, nh, dhr), f)
+        new_c_kv = _qmm(self, x, ks["W_dkv"], "W_dkv")             # (B,T,nlkv)
+        new_k_r = apply_rotary_emb(
+            _qmm(self, x, ks["W_kr"], "W_kr")[:, :, None, :], f)
 
         scale = 1.0 / jnp.sqrt(float(hs + dhr))
 
@@ -330,10 +368,14 @@ class FullMLA(nn.Module):
             new_cache = {"c_kv": c_kv, "k_r": k_r}
             # decoupled-rotary scores; single shared key head broadcasts
             attn_r = jnp.einsum("btnh,bskh->bnts", q_r, k_r.astype(dt))
-            y = _absorbed_decode(q_c, c_kv, ks["W_uk"], ks["W_uv"], pos,
+            from distributed_pytorch_tpu.ops.quant import \
+                maybe_dequantized_param
+            kuk = maybe_dequantized_param((*self.path, "W_uk"), ks["W_uk"])
+            kuv = maybe_dequantized_param((*self.path, "W_uv"), ks["W_uv"])
+            y = _absorbed_decode(q_c, c_kv, kuk, kuv, pos,
                                  scale, extra_scores=attn_r)
 
-        y = y @ ks["W_o"].astype(dt)
+        y = _qmm(self, y, ks["W_o"], "W_o")
         y = nn.Dropout(cfg.dropout, deterministic=deterministic)(y)
         return y, new_cache
 
@@ -354,11 +396,27 @@ def Attention(config: LLMConfig, attn_impl: str = "auto",
 
 def init_attn_cache(config: LLMConfig, batch_size: int, max_len: int,
                     dtype=jnp.float32) -> Cache:
-    """Per-layer static-shape KV cache buffers (see module docstring note 3)."""
+    """Per-layer static-shape KV cache buffers (see module docstring note 3).
+
+    `dtype=jnp.int8` builds the quantized cache (ops/quant.py): int8 code
+    buffers plus f32 per-(row, kv-head) scale sidecars — the (B, S, n_kv,
+    1) layout keeps `sharding.decode_cache_pspec` placing the kv-head axis
+    over 'model' and slots over 'data' exactly like the code buffers.
+    GQA family only; gate with `quant_kv_usable` (MLA falls back to bf16)."""
     B, S = batch_size, max_len
     if config.attn in ("mha", "mqa", "gqa"):
         shape = (B, S, config.n_kv_heads, config.head_size)
+        if jnp.dtype(dtype) == jnp.int8:
+            sc = (B, S, config.n_kv_heads, 1)
+            return {"k": jnp.zeros(shape, jnp.int8),
+                    "k_scale": jnp.zeros(sc, jnp.float32),
+                    "v": jnp.zeros(shape, jnp.int8),
+                    "v_scale": jnp.zeros(sc, jnp.float32)}
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    if jnp.dtype(dtype) == jnp.int8:
+        raise ValueError(
+            "int8 KV cache supports the GQA family only (quant_kv_usable "
+            "gates this; MLA latent caches stay in the compute dtype)")
     cache = {"c_kv": jnp.zeros((B, S, config.kv_latent_dim), dtype)}
     if config.pos_emb == "rope":
         cache["k_r"] = jnp.zeros((B, S, 1, config.rope_head_dim), dtype)

@@ -252,16 +252,25 @@ class LLM(nn.Module):
             # unused — as in the trainer, which takes only `loss` — XLA
             # dead-code-eliminates this matmul.
             logits = tkn_emb.attend(x)
-        elif logits_idx is None:
-            logits = tkn_emb.attend(x[:, -1:, :])  # last position only (:694)
-            loss = None
         else:
-            # bucketed prefill: each sequence's true last token sits at its
-            # own row of the right-padded buffer
-            sel = jnp.take_along_axis(
-                x, jnp.reshape(logits_idx, (-1, 1, 1)).astype(jnp.int32),
-                axis=1)
-            logits = tkn_emb.attend(sel)           # (B, 1, V)
+            if logits_idx is None:
+                sel = x[:, -1:, :]                 # last position only (:694)
+            else:
+                # bucketed prefill: each sequence's true last token sits at
+                # its own row of the right-padded buffer
+                sel = jnp.take_along_axis(
+                    x, jnp.reshape(logits_idx, (-1, 1, 1)).astype(jnp.int32),
+                    axis=1)
+            # weight-only int8 decode: the tied lm-head matmul — the
+            # single largest weight read of a decode step — reads int8
+            # codes + per-vocab-row scales when the engine's quantized
+            # store is active (ops/quant.py); otherwise the plain attend
+            from distributed_pytorch_tpu.ops.quant import \
+                maybe_quantized_matmul
+            logits = maybe_quantized_matmul(
+                sel, ("tkn_emb", "embedding"), transpose_b=True)
+            if logits is None:
+                logits = tkn_emb.attend(sel)       # (B, 1, V)
             loss = None
 
         return logits, loss, new_caches

@@ -97,7 +97,8 @@ def _is_gated(name: str) -> bool:
 
 
 def mlp_apply(x: jnp.ndarray, w_fc: jnp.ndarray, w_proj: jnp.ndarray,
-              non_linearity: str, *, overlap: bool = False) -> jnp.ndarray:
+              non_linearity: str, *, overlap: bool = False,
+              qnames: tuple | None = None) -> jnp.ndarray:
     """Apply one MLP given its kernels; shared by dense MLP and experts.
 
     Gated variants ('swiglu'/'glu'): w_fc is (C, 2*up_dim), split in half,
@@ -109,9 +110,19 @@ def mlp_apply(x: jnp.ndarray, w_fc: jnp.ndarray, w_proj: jnp.ndarray,
     param all-gather runs as a ppermute ring fused with the matmul;
     otherwise the dispatcher declines and the plain `@` below is
     bit-identical to the pre-overlap code path.
+
+    `qnames=(fc_path, proj_path)` (dense MLP only) offers both matmuls to
+    the weight-only-int8 store first (ops/quant.py): under an engine
+    decode step with quantized params they read int8 codes +
+    per-output-channel scales (applied before the gating split — exact,
+    the scale is per column of the fused fc output); elsewhere the lookup
+    misses and nothing changes.
     """
     h = None
-    if overlap:
+    if qnames is not None:
+        from distributed_pytorch_tpu.ops.quant import maybe_quantized_matmul
+        h = maybe_quantized_matmul(x, qnames[0])
+    if h is None and overlap:
         from distributed_pytorch_tpu.ops.collective_matmul import (
             maybe_overlap_matmul)
         h = maybe_overlap_matmul(x, w_fc, names=("c_fc",))
@@ -125,7 +136,10 @@ def mlp_apply(x: jnp.ndarray, w_fc: jnp.ndarray, w_proj: jnp.ndarray,
     else:
         h = _activation(non_linearity)(h)
     y = None
-    if overlap:
+    if qnames is not None:
+        from distributed_pytorch_tpu.ops.quant import maybe_quantized_matmul
+        y = maybe_quantized_matmul(h, qnames[1])
+    if y is None and overlap:
         from distributed_pytorch_tpu.ops.collective_matmul import (
             maybe_overlap_matmul)
         y = maybe_overlap_matmul(h, w_proj, names=("c_proj",))
@@ -147,7 +161,8 @@ class MLP(nn.Module):
         w_fc = self.param("c_fc", _DENSE_INIT, (C, fc_out), jnp.float32)
         w_proj = self.param("c_proj", _DENSE_INIT, (up, C), jnp.float32)
         y = mlp_apply(x, w_fc.astype(x.dtype), w_proj.astype(x.dtype),
-                      cfg.non_linearity, overlap=True)
+                      cfg.non_linearity, overlap=True,
+                      qnames=((*self.path, "c_fc"), (*self.path, "c_proj")))
         return nn.Dropout(cfg.dropout, deterministic=deterministic)(y)
 
 

@@ -124,7 +124,9 @@ def sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
          dropout_rate: float = 0.0,
          dropout_rng=None,
          impl: str = "auto",
-         decode: bool = False) -> jnp.ndarray:
+         decode: bool = False,
+         k_scale: Optional[jnp.ndarray] = None,
+         v_scale: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Scaled dot-product attention over (B, T, N, H)-layout tensors.
 
     `q_offset` is the global position of q[:, 0] (nonzero during KV-cached
@@ -133,6 +135,11 @@ def sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     exempt from the ring/ulysses fail-loud check below — decoding is never
     sequence-parallel, even when a prompt exactly fills the cache and the
     shapes look like a training step.
+
+    `k_scale`/`v_scale` (B, S, n_kv, 1) mark an int8-quantized KV cache
+    (ops/quant.py): k/v hold int8 codes. The flash-decode kernel
+    dequantizes in VMEM (half the cache DMA); every other path
+    dequantizes the buffers up front and proceeds unchanged.
     """
     hs = q.shape[-1]
     scale = (1.0 / hs ** 0.5) if scale is None else scale
@@ -165,8 +172,17 @@ def sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                 k.shape[1])
             cl = jnp.broadcast_to(cl, (q.shape[0],))
             out = flash_decode(q[:, 0], k, v, cl, scale=scale,
+                               k_scale=k_scale, v_scale=v_scale,
                                interpret=not _on_tpu())
             return out[:, None]
+
+    if k_scale is not None:
+        # int8 cache on a non-kernel path (prefill, kernel gate declined,
+        # FLASH_DECODE=off): dequantize up front — identical semantics to
+        # a bf16 cache holding the dequantized values, more HBM traffic.
+        from distributed_pytorch_tpu.ops.quant import dequantize_int8
+        k = dequantize_int8(k, k_scale, q.dtype)
+        v = dequantize_int8(v, v_scale, q.dtype)
 
     # Sequence parallelism: when the ambient mesh (parallel/context.py) has
     # a live 'seq' axis and shapes allow, full-sequence causal attention

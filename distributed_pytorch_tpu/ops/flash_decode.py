@@ -120,16 +120,78 @@ def _kernel(cl_ref, q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref, *,
                     ).astype(o_ref.dtype)
 
 
+def _kernel_q8(cl_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref,
+               acc_ref, m_ref, l_ref, *, scale: float, block_s: int):
+    """int8-cache variant: the K/V tiles arrive as int8 codes (half the
+    DMA bytes of bf16) with their per-(row, kv-head) scale rows riding the
+    same index map — the cache_len block-skip logic is shared, so dead
+    blocks skip compute AND the (now half-sized) DMA. Dequantization
+    happens in VMEM registers: the codes cast to the compute dtype on the
+    way into the MXU tile, and the row scales fold into the score /
+    probability tiles (exact algebra — k's scale is constant along each
+    score column, v's along each summed row), so a dequantized K/V buffer
+    never exists anywhere."""
+    b, j = pl.program_id(0), pl.program_id(1)
+    n = cl_ref[b]
+    last_j = jax.lax.div(jnp.maximum(n, 1) - 1, block_s)
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    @pl.when(j <= last_j)
+    def _():
+        q = q_ref[0]                            # (nkv, rep, hs) bf16/f32
+        dt = q.dtype
+        k = k_ref[0].transpose(1, 0, 2).astype(dt)   # (nkv, bs, hs) codes
+        v = v_ref[0].transpose(1, 0, 2).astype(dt)
+        # scale rows (block_s, nkv, 1) -> (nkv, 1, block_s): one scale per
+        # key row, broadcast over the rep (query-head) sublane dim
+        ks = ks_ref[0].transpose(1, 2, 0)
+        vs = vs_ref[0].transpose(1, 2, 0)
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        s = s * (ks * scale)                    # dequant k + softmax scale
+        kpos = j * block_s + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(kpos < n, s, _NEG_INF)
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            (p * vs).astype(dt), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)   # dequant v folded into p
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
+                    ).astype(o_ref.dtype)
+
+
 def flash_decode(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                  cache_len: jnp.ndarray, *, scale: float,
+                 k_scale: jnp.ndarray = None, v_scale: jnp.ndarray = None,
                  block_s: int = 0, interpret: bool = False) -> jnp.ndarray:
     """Single-token cached attention: q (B, nh, hs) against k/v
     (B, S, n_kv, hs) cache buffers with per-sequence valid lengths
     `cache_len` (B,) int32 (rows [0, cache_len) are attended; the rest are
-    dead slots). Returns (B, nh, hs). Gate with `flash_decode_usable`."""
+    dead slots). Returns (B, nh, hs). Gate with `flash_decode_usable`.
+
+    With `k_scale`/`v_scale` (B, S, n_kv, 1) — the int8-cache scale
+    sidecars (ops/quant.py) — k/v hold int8 codes and the `_kernel_q8`
+    variant dequantizes in VMEM (half the cache DMA bytes; the block-skip
+    logic is shared)."""
     B, nh, hs = q.shape
     S, nkv = k.shape[1], k.shape[2]
     rep = nh // nkv
+    quantized = k_scale is not None
+    assert quantized == (v_scale is not None), \
+        "int8 cache needs both k_scale and v_scale"
     block_s = block_s or _pick_block(S, DEFAULT_BLOCK_S,
                                      8 if interpret else 128)
     assert block_s and S % block_s == 0, (
@@ -147,14 +209,32 @@ def flash_decode(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         last = jax.lax.div(jnp.maximum(cl_ref[b], 1) - 1, block_s)
         return (b, jnp.minimum(j, last), 0, 0)
 
+    in_specs = [pl.BlockSpec((1, nkv, rep, hs), q_idx)]
+    operands = [q4]
+    if quantized:
+        # scale rows share the kv index map, so skipped blocks skip their
+        # (tiny) DMA too
+        in_specs += [
+            pl.BlockSpec((1, block_s, nkv, hs), kv_idx),
+            pl.BlockSpec((1, block_s, nkv, 1), kv_idx),
+            pl.BlockSpec((1, block_s, nkv, hs), kv_idx),
+            pl.BlockSpec((1, block_s, nkv, 1), kv_idx),
+        ]
+        operands += [k, k_scale.astype(jnp.float32),
+                     v, v_scale.astype(jnp.float32)]
+        body = _kernel_q8
+    else:
+        in_specs += [
+            pl.BlockSpec((1, block_s, nkv, hs), kv_idx),
+            pl.BlockSpec((1, block_s, nkv, hs), kv_idx),
+        ]
+        operands += [k, v]
+        body = _kernel
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(B, S // block_s),
-        in_specs=[
-            pl.BlockSpec((1, nkv, rep, hs), q_idx),
-            pl.BlockSpec((1, block_s, nkv, hs), kv_idx),
-            pl.BlockSpec((1, block_s, nkv, hs), kv_idx),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, nkv, rep, hs), q_idx),
         scratch_shapes=[
             pltpu.VMEM((nkv, rep, hs), jnp.float32),
@@ -163,13 +243,13 @@ def flash_decode(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_kernel, scale=float(scale), block_s=block_s),
+        functools.partial(body, scale=float(scale), block_s=block_s),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, nkv, rep, hs), q.dtype),
         compiler_params=tpu_compiler_params(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(cl, q4, k, v)
+    )(cl, *operands)
     return out.reshape(B, nh, hs)
 
 
@@ -177,12 +257,15 @@ def flash_decode_usable(q, k, v) -> bool:
     """Static gate for the dispatcher: (B, 1, nh, hs)-shaped decode query,
     dtypes/shapes the kernel tiles, no live multi-device mesh (GSPMD
     cannot partition a pallas_call; a shard_map wrap over 'data' is future
-    work — the naive path handles sharded decode meanwhile)."""
+    work — the naive path handles sharded decode meanwhile). An int8 k/v
+    (the quantized cache's codes) is accepted — `_kernel_q8` carries it."""
     if q.ndim != 4 or q.shape[1] != 1:
         return False
     B, _, nh, hs = q.shape
     S, nkv = k.shape[1], k.shape[2]
     if q.dtype not in (jnp.float32, jnp.bfloat16):
+        return False
+    if k.dtype != q.dtype and k.dtype != jnp.int8:
         return False
     if hs % 8 != 0 or nh % nkv != 0:
         return False
@@ -194,9 +277,11 @@ def flash_decode_usable(q, k, v) -> bool:
     mesh = context.get_mesh()
     if mesh is not None and any(s > 1 for s in mesh.devices.shape):
         return False
-    dsize = jnp.dtype(q.dtype).itemsize
+    dsize = jnp.dtype(k.dtype).itemsize
     rep = nh // nkv
     tiles = 2 * 2 * block_s * nkv * hs * dsize          # double-buffered k+v
+    if k.dtype == jnp.int8:
+        tiles += 2 * 2 * block_s * nkv * 4              # f32 scale rows
     scratch = nkv * rep * (hs + 2) * 4
     scores = 3 * nkv * rep * block_s * 4                # s, p, mask temps
     return tiles + scratch + scores <= _VMEM_BUDGET

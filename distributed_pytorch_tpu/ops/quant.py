@@ -1,0 +1,246 @@
+"""Symmetric int8 quantization for the serving path: KV cache + weights.
+
+PERF.md round-8's bytes-moved model says decode is bandwidth-bound — a
+124M bf16 model is ~250 MB of weights per step and the KV cache adds ~20%
+more at half occupancy — so the round-9 lever is halving those bytes:
+
+* **int8 KV cache**: `_update_cache`'s ring write (models/attention.py)
+  quantizes each incoming K/V row to int8 codes plus a float32 scale
+  sidecar that rides the cache pytree (same slot/kv-head shardings via
+  `sharding.decode_cache_pspec` — the sidecar keeps the (B, S, n_kv, 1)
+  layout so the kv-head axis shards over 'model' exactly like the codes).
+  Scales are per-(cache-row, kv-head), i.e. one scale per written token
+  per kv head, reduced over the head-dim channel: the only granularity
+  consistent with O(1) incremental ring writes — a per-channel-over-time
+  scale would need a full-buffer requantization whenever a new token
+  raised the running max. The flash-decode kernel DMAs the int8 blocks
+  plus their scale rows and dequantizes in VMEM registers (the scale
+  folds into the score/probability tiles — the MXU tiles operate on cast
+  codes, never on a materialized dequantized buffer); the naive fallback
+  dequantizes the buffers up front.
+* **weight-only int8**: `quantize_params` turns every 2D matmul kernel
+  (fused qkv, out-projections, MLP up/down, MLA projections, the tied
+  lm-head embedding) into int8 codes + a per-output-channel float32
+  scale. The decode step runs `y = (x @ codes) * scale` — the cast
+  happens in VMEM on the way into the MXU, the scale on the (B, 1, out)
+  output — algebraically exact given the codes. Prefill keeps the bf16
+  originals (quantization error is paid once per generated token, not
+  amplified over a long prompt). Stacked MoE expert kernels and the
+  pp-stacked 'blocks' layout are excluded (decode never touches pp;
+  expert quantization is future work — unquantized call sites simply
+  keep their bf16 matmul, which is always correct).
+
+Gates follow the `FLASH_DECODE`/`OVERLAP` contract: `QUANT_KV` /
+`QUANT_W` = `auto|on|off`, read per call so tests and bench legs can flip
+them per subprocess. 'auto' defers to the caller's explicit request
+(`DecodeEngine(cache_dtype='int8', quantize_weights=True)`, sample.py
+flags) and therefore resolves to OFF until someone asks — quantization
+changes numerics, so no path turns it on silently before a silicon A/B
+exists. 'on'/'off' force it for the bench/sweep legs. `quant_kv_usable`
+is the degrade-don't-crash predicate: where int8 KV isn't supported (MLA
+latent caches — already ~8x compressed; int8 there compounds error) the
+engine falls back to bf16 instead of crashing, like
+`flash_decode_usable`/`grouped_usable`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Any, Mapping, Optional
+
+import jax
+import jax.numpy as jnp
+
+# int8 symmetric range: +-127 (the -128 code is unused so the grid is
+# symmetric and dequant is a pure scale multiply)
+_Q_MAX = 127.0
+
+
+def kv_quant_mode() -> str:
+    """'auto' | 'on' | 'off' — read per call (tests monkeypatch env)."""
+    return os.environ.get("QUANT_KV", "auto").strip().lower() or "auto"
+
+
+def weight_quant_mode() -> str:
+    return os.environ.get("QUANT_W", "auto").strip().lower() or "auto"
+
+
+def resolve_gate(mode: str, requested: bool) -> bool:
+    """Apply the auto|on|off contract: 'auto' follows the caller's explicit
+    request (default off — quantization never turns on silently), 'on' and
+    'off' force, e.g. from a bench leg's env."""
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(f"quant mode must be auto|on|off, got {mode!r}")
+    if mode == "auto":
+        return requested
+    return mode == "on"
+
+
+def quant_kv_usable(cfg) -> bool:
+    """Static gate: int8 KV is supported for the GQA family (mha/mqa/gqa)
+    whose cache rows are per-head vectors a row-wise scale covers. MLA's
+    latent cache declines — callers fall back to the bf16 cache
+    (degrade-don't-crash), never to an error."""
+    return getattr(cfg, "attn", None) in ("mha", "mqa", "gqa")
+
+
+# ---------------------------------------------------------------------------
+# core quantize / dequantize
+# ---------------------------------------------------------------------------
+
+def quantize_int8(x: jnp.ndarray, axis) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric int8: codes = round(x / scale), scale = amax/127 reduced
+    over `axis` (kept as size-1 dims so dequant is a broadcast multiply).
+    All-zero groups get scale 0 and codes 0 (dequant returns exact zeros —
+    dead cache slots stay clean)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=axis, keepdims=True)
+    scale = amax / _Q_MAX
+    inv = jnp.where(scale > 0, 1.0 / jnp.maximum(scale, 1e-30), 0.0)
+    codes = jnp.clip(jnp.round(xf * inv), -_Q_MAX, _Q_MAX).astype(jnp.int8)
+    return codes, scale
+
+
+def dequantize_int8(codes: jnp.ndarray, scale: jnp.ndarray,
+                    dtype=jnp.float32) -> jnp.ndarray:
+    return (codes.astype(jnp.float32) * scale).astype(dtype)
+
+
+def quantize_kv(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Quantize new K/V rows (B, T, n_kv, hs) for the ring write: int8
+    codes + per-(row, kv-head) scales (B, T, n_kv, 1) — the sidecar that
+    rides the cache pytree."""
+    return quantize_int8(x, axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# weight-only int8: pytree transforms
+# ---------------------------------------------------------------------------
+
+# 2D matmul param names eligible for weight-only int8. All are (in, out)
+# kernels scaled per output channel, except the tied embedding (V, C)
+# whose lm-head matmul contracts C — its "output channel" is the vocab
+# row. MoE expert stacks (3D) and anything under the pp 'blocks' layout
+# are excluded (see module docstring).
+_KERNEL_NAMES = frozenset((
+    "kernel", "c_fc", "c_proj",
+    "W_dq", "W_uq", "W_dkv", "W_uk", "W_uv", "W_o", "W_qr", "W_kr",
+))
+
+
+def _quant_axis(names: tuple[str, ...], ndim: int) -> Optional[int]:
+    """Reduction axis for one param leaf, or None when it stays bf16."""
+    if not names or names[0] == "blocks" or ndim != 2:
+        return None
+    last = names[-1]
+    if last == "embedding":
+        return 1      # (V, C): scale per vocab row (lm-head output channel)
+    if last in _KERNEL_NAMES:
+        return 0      # (in, out): scale per output channel
+    return None
+
+
+def quantize_params(params: Mapping) -> dict:
+    """params pytree -> sparse nested dict of {'q8': int8, 'scale': f32}
+    leaves for every eligible matmul kernel (biases, norms, expert stacks
+    pass through untouched by NOT appearing — call sites that find no
+    entry keep their bf16 matmul)."""
+    def rec(node, names):
+        if isinstance(node, Mapping):
+            out = {}
+            for k, v in node.items():
+                sub = rec(v, names + (k,))
+                if sub is not None:
+                    out[k] = sub
+            return out or None
+        ax = _quant_axis(names, getattr(node, "ndim", 0))
+        if ax is None:
+            return None
+        codes, scale = quantize_int8(node, axis=ax)
+        return {"q8": codes, "scale": scale}
+    return rec(params, ()) or {}
+
+
+def dequantize_params(qtree: Mapping, dtype=jnp.float32) -> dict:
+    """Inverse transform: the sparse quantized tree -> same-structured tree
+    of dequantized dense arrays (the reference for parity tests)."""
+    def rec(node):
+        if isinstance(node, Mapping) and "q8" in node and "scale" in node:
+            return dequantize_int8(node["q8"], node["scale"], dtype)
+        return {k: rec(v) for k, v in node.items()}
+    return rec(qtree)
+
+
+# ---------------------------------------------------------------------------
+# ambient quantized-weight store (the engine's decode step enters this
+# around model.apply; call sites consult it by param path)
+# ---------------------------------------------------------------------------
+
+_ACTIVE: Optional[Mapping] = None
+
+
+@contextlib.contextmanager
+def use_quantized_params(store: Optional[Mapping]):
+    """Trace-time context (the parallel.context.use_mesh idiom): make a
+    quantized-param store visible to the matmul call sites for the
+    duration of a model.apply trace. Pass the store THROUGH the jitted
+    function's arguments (never close over concrete arrays — they would
+    bake into the executable as constants)."""
+    global _ACTIVE
+    prev = _ACTIVE
+    _ACTIVE = store or None
+    try:
+        yield
+    finally:
+        _ACTIVE = prev
+
+
+def _lookup(names: tuple[str, ...]):
+    node = _ACTIVE
+    if node is None:
+        return None
+    for n in names:
+        if not isinstance(node, Mapping) or n not in node:
+            return None
+        node = node[n]
+    if isinstance(node, Mapping) and "q8" in node:
+        return node
+    return None
+
+
+def maybe_quantized_matmul(x: jnp.ndarray, names, *,
+                           transpose_b: bool = False) -> Optional[jnp.ndarray]:
+    """`x @ W` from the active quantized store, or None when no store is
+    active / the path has no entry (caller keeps its bf16 matmul).
+
+    The codes cast to x.dtype in VMEM on the way into the MXU; the
+    per-output-channel scale is applied to the (small) decode-shaped
+    output in f32 — `(x @ codes) * scale` is algebraically exact given
+    the codes. `transpose_b` is the tied-embedding lm head: codes (V, C),
+    scale per vocab row."""
+    qt = _lookup(tuple(names))
+    if qt is None:
+        return None
+    codes, scale = qt["q8"], qt["scale"]
+    w = codes.astype(x.dtype)
+    if transpose_b:
+        y = jnp.einsum("...c,vc->...v", x, w)
+        s = scale.reshape(-1)          # (V,)
+    else:
+        y = x @ w
+        s = scale.reshape(-1)          # (out,)
+    return (y.astype(jnp.float32) * s).astype(x.dtype)
+
+
+def maybe_dequantized_param(names, fallback: jnp.ndarray,
+                            dtype=None) -> jnp.ndarray:
+    """The active store's dequantized weight for `names`, else `fallback`
+    unchanged — for call sites that contract a kernel in a reshaped form
+    (MLA's absorbed W_uk/W_uv) where folding the scale into the matmul
+    output isn't a plain broadcast."""
+    qt = _lookup(tuple(names))
+    if qt is None:
+        return fallback
+    return dequantize_int8(qt["q8"], qt["scale"],
+                           dtype or fallback.dtype)

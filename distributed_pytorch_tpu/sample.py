@@ -46,6 +46,17 @@ def main(argv=None) -> None:
                    help="restore the checkpoint sharded over all local "
                         "devices using its training recipe's layout — for "
                         "models larger than one device's memory")
+    p.add_argument("--cache-dtype", "--cache_dtype", dest="cache_dtype",
+                   default="", choices=["", "int8", "bfloat16", "float32"],
+                   help="KV-cache dtype for decoding; 'int8' quantizes the "
+                        "cache on the ring write (ops/quant.py) and routes "
+                        "decoding through the DecodeEngine")
+    p.add_argument("--quant-weights", "--quant_weights",
+                   dest="quant_weights", action="store_true",
+                   help="weight-only int8 decode: params quantized once, "
+                        "decode matmuls read int8 codes + per-channel "
+                        "scales (prefill stays bf16); routes decoding "
+                        "through the DecodeEngine")
     args = p.parse_args(argv)
 
     from distributed_pytorch_tpu.models.generate import make_generate_fn
@@ -136,6 +147,33 @@ def main(argv=None) -> None:
     bucket = min(bucket, model_cfg.block_size)
     prompt = jnp.asarray(ids + [0] * (bucket - T0), jnp.int32)[None]
 
+    import time
+    n_new = args.num_samples * args.max_new_tokens
+    if args.cache_dtype or args.quant_weights:
+        # quantized serving knobs route through the DecodeEngine (the
+        # generate scan has no quantized path): one slot per sample,
+        # continuous batching degenerate to a single admit wave
+        from distributed_pytorch_tpu.engine import DecodeEngine
+        eng = DecodeEngine(model, variables, n_slots=args.num_samples,
+                           cache_dtype=args.cache_dtype or None,
+                           quantize_weights=args.quant_weights,
+                           temperature=args.temperature, top_k=args.top_k,
+                           rng=jax.random.PRNGKey(args.seed),
+                           mesh=mesh,
+                           recipe=train_cfg.parallelism if mesh is not None
+                           else "single")
+        t0 = time.perf_counter()
+        outs = eng.run([ids] * args.num_samples, args.max_new_tokens)
+        dt = time.perf_counter() - t0
+        print(f"decode: {n_new} tokens in {dt:.2f}s "
+              f"({n_new / dt:.1f} tok/s, incl. compile on first call; "
+              f"engine, cache={jnp.dtype(eng.cache_dtype).name} "
+              f"quant_w={eng.weights_quantized})")
+        for toks in outs:
+            print("-" * 40)
+            print(enc.decode(toks) if enc is not None else toks)
+        return
+
     gen = make_generate_fn(model, args.max_new_tokens,
                            temperature=args.temperature, top_k=args.top_k)
     rng = jax.random.PRNGKey(args.seed)
@@ -146,14 +184,13 @@ def main(argv=None) -> None:
         # jax.random.categorical draws independent noise per batch row
         prompts = jnp.tile(prompt, (args.num_samples, 1))
         lens = jnp.full((args.num_samples,), T0, jnp.int32)
-        import time
         t0 = time.perf_counter()
         out = jax.device_get(gen(variables, prompts, rng, lens))
         dt = time.perf_counter() - t0
-    n_new = args.num_samples * args.max_new_tokens
     print(f"decode: {n_new} tokens in {dt:.2f}s "
           f"({n_new / dt:.1f} tok/s, incl. compile on first call; "
-          f"prompt bucket {T0} -> {bucket})")
+          f"prompt bucket {T0} -> {bucket}; "
+          f"cache={jnp.dtype(model.compute_dtype).name} quant_w=False)")
     for toks in out.tolist():
         # splice out the pad tail: [prompt, pad, generated] -> real tokens
         toks = toks[:T0] + toks[bucket:]

@@ -61,32 +61,99 @@ def save_checkpoint(path: str, state: TrainState,
 
 _async_ckptr: Optional[ocp.AsyncCheckpointer] = None
 
+# Double-buffered snapshot state: the PREVIOUS interval save's snapshot
+# pytree. After wait_until_finished its buffers are idle, so on TPU they
+# are DONATED as the destination of the next snapshot's per-leaf copies —
+# steady-state interval saves allocate nothing and the copy cost is pure
+# HBM bandwidth. (CPU jit ignores donation with a warning on this jax, so
+# there the per-leaf copies simply allocate; same semantics.)
+_snapshot_prev: Optional[TrainState] = None
+#: wall-clock ms of the most recent pre-save snapshot copy — the
+#: `ckpt_snapshot_ms` metric the training loop logs so the 1.5B
+#: step-time dent is visible (ROADMAP async-checkpoint item).
+last_snapshot_ms: float = 0.0
+
+_copy_into = None  # lazily-built jitted per-leaf donated copy
+
+
+def _leaf_copy_fns():
+    global _copy_into
+    if _copy_into is None:
+        import functools
+        import jax.numpy as jnp
+        # dst is donated and otherwise unused: jax pairs donated inputs
+        # with same-shaped outputs, so the copy of src lands in dst's
+        # buffer. `+ 0`-style identity would alias src instead; lax.copy
+        # semantics via jnp.copy inside jit forces a materialized value.
+        _copy_into = jax.jit(
+            lambda dst, src: jnp.copy(src), donate_argnums=(0,))
+    return _copy_into
+
+
+def _snapshot_state(state: TrainState) -> TrainState:
+    """Donation-proof pre-save snapshot with per-leaf buffer reuse.
+
+    The train step donates its state argument (train/step.py
+    donate_argnums=(0,)), so the buffers behind `state` are REUSED by the
+    very next optimizer step while orbax's background thread is still
+    reading them — observed live on the CPU mesh: an interval save at
+    it=4 persisted state.step == 7 (the run's final state), which made
+    --resume skip the remaining iterations entirely. The snapshot copy is
+    that race's fix, paid explicitly; this version reuses the previous
+    (now idle) snapshot's buffers per leaf instead of allocating a fresh
+    full-state copy each save, and records the measured copy time in
+    `last_snapshot_ms`."""
+    global _snapshot_prev, last_snapshot_ms
+    import time
+
+    t0 = time.perf_counter()
+    prev = _snapshot_prev
+    reuse = False
+    if prev is not None and jax.default_backend() == "tpu":
+        try:
+            pl = jax.tree_util.tree_leaves(prev)
+            sl = jax.tree_util.tree_leaves(state)
+            reuse = (jax.tree_util.tree_structure(prev)
+                     == jax.tree_util.tree_structure(state)
+                     and len(pl) == len(sl)
+                     and all(isinstance(a, jax.Array)
+                             and isinstance(b, jax.Array)
+                             and a.shape == b.shape and a.dtype == b.dtype
+                             and a.sharding == b.sharding
+                             for a, b in zip(pl, sl)))
+        except Exception:  # noqa: BLE001 — reuse is a pure optimization
+            reuse = False
+    if reuse:
+        copy = _leaf_copy_fns()
+        snap = jax.tree_util.tree_map(
+            lambda dst, src: copy(dst, src)
+            if isinstance(src, jax.Array) else src, prev, state)
+    else:
+        snap = jax.tree_util.tree_map(
+            lambda x: x.copy() if isinstance(x, jax.Array) else x, state)
+    snap = jax.block_until_ready(snap)  # measure the copy, not dispatch
+    last_snapshot_ms = (time.perf_counter() - t0) * 1e3
+    _snapshot_prev = snap
+    return snap
+
 
 def save_checkpoint_async(path: str, state: TrainState,
                           model_cfg: Optional[LLMConfig] = None,
                           train_cfg: Optional[TrainConfig] = None) -> str:
-    """Non-blocking interval save: device buffers are snapshotted, the
-    serialization runs on background threads, and training continues —
-    the reference's (dead-coded) saves all block (kaggle-fsdp.py:1141).
-    Any in-flight previous save is waited on first (bounds host memory to
-    one outstanding snapshot); call `wait_for_saves()` before process
-    exit. Orbax finalizes atomically, so `latest_step_dir` never sees a
-    torn checkpoint."""
+    """Non-blocking interval save: device buffers are snapshotted (per-leaf
+    copies into the previous snapshot's reused buffers — `_snapshot_state`;
+    copy time in `last_snapshot_ms`), the serialization runs on background
+    threads, and training continues — the reference's (dead-coded) saves
+    all block (kaggle-fsdp.py:1141). Any in-flight previous save is waited
+    on first (bounds host memory to one outstanding snapshot); call
+    `wait_for_saves()` before process exit. Orbax finalizes atomically, so
+    `latest_step_dir` never sees a torn checkpoint."""
     global _async_ckptr
     if _async_ckptr is None:
         _async_ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
     _async_ckptr.wait_until_finished()
     path = _abs(path)
-    # Donation-proof snapshot: the train step donates its state argument
-    # (train/step.py donate_argnums=(0,)), so the buffers behind `state`
-    # are REUSED by the very next optimizer step while orbax's background
-    # thread is still reading them — observed live on the CPU mesh: an
-    # interval save at it=4 persisted state.step == 7 (the run's final
-    # state), which made --resume skip the remaining iterations entirely.
-    # .copy() allocates fresh buffers with the same sharding; the copy is
-    # the usual async-checkpoint snapshot cost, paid explicitly.
-    state = jax.tree_util.tree_map(
-        lambda x: x.copy() if isinstance(x, jax.Array) else x, state)
+    state = _snapshot_state(state)
     _async_ckptr.save(os.path.join(path, "state"),
                       args=ocp.args.StandardSave(state), force=True)
     _write_meta(path, state, model_cfg, train_cfg)

@@ -424,7 +424,13 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
                 path = ckpt.save_checkpoint_async(
                     os.path.join(ckpt_root, f"step_{it}"), state,
                     model_cfg, train_cfg)
-                say(f"checkpoint (async) -> {path}")
+                # the pre-save snapshot copy is the one synchronous cost an
+                # async save keeps; track it so the 1.5B step-time dent is
+                # visible (ROADMAP async-checkpoint item)
+                stats.setdefault("ckpt_snapshot_ms", []).append(
+                    round(ckpt.last_snapshot_ms, 2))
+                say(f"checkpoint (async) -> {path} "
+                    f"(snapshot {ckpt.last_snapshot_ms:.0f}ms)")
                 win_t0 = time.perf_counter()       # ckpt time isn't step time
 
     if train_cfg.profile and is_main:

@@ -233,6 +233,68 @@ def estimate_peak_gb(cfg: LLMConfig, recipe: str, micro_batch: int,
     return total, {k: round(v, 3) for k, v in breakdown.items()}
 
 
+def estimate_serving_gb(model_cfg: LLMConfig, n_slots: int, max_len: int, *,
+                        cache_dtype_size: int = 2,
+                        quantize_weights: bool = False,
+                        compute_dtype_size: int = 2,
+                        n_params: Optional[int] = None
+                        ) -> tuple[float, dict]:
+    """Serving-memory estimate for one chip running the DecodeEngine:
+    the bf16 serving weights (prefill always needs them), the int8 decode
+    copy + its per-output-channel f32 scales when `quantize_weights`, the
+    (n_slots, max_len) KV cache at its true itemsize (+ the f32 scale
+    sidecars for an int8 cache, cache_dtype_size=1), and a small
+    activation term — so slot counts can be planned per chip instead of
+    OOM-bisected on hardware. Closed-form + jax.eval_shape only, like
+    plan_memory."""
+    from distributed_pytorch_tpu.train import metrics as M
+
+    P = n_params if n_params is not None else param_count(model_cfg)
+    weights_b = P * compute_dtype_size
+    quant_b = 0.0
+    if quantize_weights:
+        quant_b = (M.quantized_matmul_params_per_token(model_cfg)
+                   + M.quantized_matmul_out_channels(model_cfg) * 4)
+    cache_b = n_slots * max_len * M.kv_bytes_per_token(
+        model_cfg, cache_dtype_size, kv_scales=cache_dtype_size == 1)
+    # decode activations: a few (n_slots, C) residual/qkv rows per layer
+    # plus one (n_slots, vocab) logits buffer — tiny next to the above
+    act_b = (n_slots * model_cfg.n_embd * 8 * model_cfg.n_layer * 2
+             + n_slots * model_cfg.vocab_size * 4)
+    breakdown = {
+        "weights": weights_b / 2 ** 30,
+        "quant_weights": quant_b / 2 ** 30,
+        "kv_cache": cache_b / 2 ** 30,
+        "acts": act_b / 2 ** 30,
+    }
+    total = sum(breakdown.values()) * _FUDGE
+    return total, {k: round(v, 3) for k, v in breakdown.items()}
+
+
+def plan_decode_slots(model_cfg: LLMConfig, max_len: int, *,
+                      hbm_gb: Optional[float] = None,
+                      cache_dtype_size: int = 2,
+                      quantize_weights: bool = False,
+                      max_slots: int = 4096) -> int:
+    """Largest power-of-two slot count whose serving estimate fits the
+    per-chip HBM budget (0 when even one slot doesn't fit — the model
+    needs sharding). int8 knobs roughly double the answer: that is the
+    whole point of the quantized serving path."""
+    budget = hbm_gb if hbm_gb is not None else device_hbm_gb()
+    n_params = param_count(model_cfg)
+    best = 0
+    n = 1
+    while n <= max_slots:
+        est, _ = estimate_serving_gb(
+            model_cfg, n, max_len, cache_dtype_size=cache_dtype_size,
+            quantize_weights=quantize_weights, n_params=n_params)
+        if est > budget:
+            break
+        best = n
+        n *= 2
+    return best
+
+
 def plan_memory(model_cfg: LLMConfig, train_cfg: TrainConfig, *,
                 n_devices: Optional[int] = None,
                 hbm_gb: Optional[float] = None,

@@ -67,29 +67,54 @@ def peak_hbm_bw_per_chip() -> float | None:
     return None
 
 
-def kv_bytes_per_token(cfg: LLMConfig, cache_dtype_size: int = 2) -> int:
+def kv_bytes_per_token(cfg: LLMConfig, cache_dtype_size: int = 2, *,
+                       kv_scales: bool = False) -> int:
     """Bytes of KV cache one token occupies across all layers (GQA: 2
     (k+v) * n_kv heads * head_size; MLA: the compressed latent [+ the
-    shared rotary key head])."""
+    shared rotary key head]). `kv_scales` adds the int8 cache's f32
+    per-(row, kv-head) scale sidecars (ops/quant.py) so the int8 bytes
+    model is honest: ~ (hs + 4) / 2*hs of the bf16 bytes, not exactly
+    half."""
     if cfg.attn in ("mha", "mqa", "gqa"):
-        row = 2 * cfg.n_kv_heads * cfg.head_size
+        row = 2 * cfg.n_kv_heads * cfg.head_size * cache_dtype_size
+        if kv_scales:
+            row += 2 * cfg.n_kv_heads * 4
     else:
-        row = cfg.kv_latent_dim + (cfg.rope_head_dim
-                                   if cfg.pos_emb == "rope" else 0)
-    return cfg.n_layer * row * cache_dtype_size
+        row = (cfg.kv_latent_dim + (cfg.rope_head_dim
+                                    if cfg.pos_emb == "rope" else 0)
+               ) * cache_dtype_size
+    return cfg.n_layer * row
 
 
 def decode_step_bytes(cfg: LLMConfig, batch: int, cache_len: int,
                       param_dtype_size: int = 2,
-                      cache_dtype_size: int = 2) -> int:
+                      cache_dtype_size: int = 2, *,
+                      quant_weights: bool = False,
+                      kv_scales: bool | None = None) -> int:
     """Bytes-moved model for ONE batched decode step: every matmul
     parameter is read once (decode is weight-bandwidth-bound; the batch
     amortizes this read — why the engine batches ragged slots), each
     sequence's valid KV rows are read once, and one new row is written.
     Activations (B rows of C floats) are noise and excluded. Divide by
-    (step time x peak_hbm_bw_per_chip) for MBU."""
-    params = matmul_params_per_token(cfg) * param_dtype_size
-    kv = batch * (cache_len + 1) * kv_bytes_per_token(cfg, cache_dtype_size)
+    (step time x peak_hbm_bw_per_chip) for MBU.
+
+    True per-tensor itemsizes for every dtype mix: `cache_dtype_size=1`
+    defaults `kv_scales` on (the int8 cache always carries its f32 scale
+    sidecars); `quant_weights` prices the weight-only-int8 store — the
+    quantized matmuls read 1-byte codes plus their f32 per-output-channel
+    scale vectors, anything the store excludes (MoE expert stacks, the
+    router) stays at `param_dtype_size`."""
+    if kv_scales is None:
+        kv_scales = cache_dtype_size == 1
+    if quant_weights:
+        qp = quantized_matmul_params_per_token(cfg)
+        rest = matmul_params_per_token(cfg) - qp
+        params = (qp + quantized_matmul_out_channels(cfg) * 4
+                  + rest * param_dtype_size)
+    else:
+        params = matmul_params_per_token(cfg) * param_dtype_size
+    kv = batch * (cache_len + 1) * kv_bytes_per_token(
+        cfg, cache_dtype_size, kv_scales=kv_scales)
     return params + kv
 
 
@@ -127,6 +152,40 @@ def matmul_params_per_token(cfg: LLMConfig) -> int:
     lm_head = cfg.vocab_size * C                         # weight-tied matmul
     return attn_matmul_params_per_token(cfg) \
         + cfg.n_layer * ffn + lm_head
+
+
+def quantized_matmul_params_per_token(cfg: LLMConfig) -> int:
+    """Matmul parameters the weight-only-int8 store covers
+    (ops/quant.py quantize_params): everything matmul_params_per_token
+    counts EXCEPT the stacked MoE expert kernels and the router, which
+    stay bf16."""
+    C = cfg.n_embd
+    qp = attn_matmul_params_per_token(cfg) + cfg.vocab_size * C  # + lm head
+    if not cfg.moe:
+        fc_out = 2 * cfg.up_dim \
+            if cfg.non_linearity.lower() in ("swiglu", "glu") else cfg.up_dim
+        qp += cfg.n_layer * (C * fc_out + cfg.up_dim * C)
+    return qp
+
+
+def quantized_matmul_out_channels(cfg: LLMConfig) -> int:
+    """Output channels across the quantized matmuls — each carries one f32
+    scale, the sidecar bytes a decode step reads on top of the int8
+    codes."""
+    C, hs, nh, nkvh = cfg.n_embd, cfg.head_size, cfg.n_head, cfg.n_kv_heads
+    if cfg.attn in ("mha", "mqa", "gqa"):
+        attn = (C + 2 * nkvh * hs) + C                   # c_attn + c_proj
+    else:
+        nlq, nlkv = cfg.q_latent_dim, cfg.kv_latent_dim
+        attn = nlq + C + nlkv + 2 * C + C                # W_dq..W_uv, W_o
+        if cfg.pos_emb == "rope":
+            attn += nh * cfg.rope_head_dim + cfg.rope_head_dim
+    ch = cfg.n_layer * attn + cfg.vocab_size             # + lm-head rows
+    if not cfg.moe:
+        fc_out = 2 * cfg.up_dim \
+            if cfg.non_linearity.lower() in ("swiglu", "glu") else cfg.up_dim
+        ch += cfg.n_layer * (fc_out + C)
+    return ch
 
 
 def moe_overcompute_factor(cfg: LLMConfig) -> float:

@@ -37,52 +37,72 @@ def _time_decode(slots: int, iters: int) -> dict:
     step + valid KV rows, train/metrics.decode_step_bytes) over the chip's
     peak HBM bandwidth — printed where the train variants print MFU.
     FLASH_DECODE / FLASH_DECODE_BLOCK env knobs A/B the split-KV kernel
-    against the naive einsum path per subprocess."""
+    against the naive einsum path per subprocess; SWEEP_CACHE_DTYPE=int8 /
+    SWEEP_QUANT_W=1 add the round-9 quantized columns (int8 KV cache with
+    in-kernel dequant, weight-only int8 matmuls) with the MBU bytes priced
+    at the true itemsizes."""
+    import contextlib
+
     import jax.numpy as jnp
 
     from distributed_pytorch_tpu.config import PRESETS
     from distributed_pytorch_tpu.models.gpt import LLM, init_cache
+    from distributed_pytorch_tpu.ops.quant import (quantize_params,
+                                                   use_quantized_params)
 
     preset = os.environ.get("SWEEP_PRESET", "gpt2_124m")
     cfg = PRESETS[preset]()
     dtype = jnp.bfloat16
+    cache_dtype = jnp.int8 \
+        if os.environ.get("SWEEP_CACHE_DTYPE", "") == "int8" else dtype
+    quant_w = os.environ.get("SWEEP_QUANT_W", "") == "1"
     model = LLM(cfg, compute_dtype=dtype, attn_impl="auto")
     rng = jax.random.PRNGKey(0)
     dummy = jnp.zeros((1, cfg.block_size), jnp.int32)
     variables = jax.jit(model.init)({"params": rng, "dropout": rng},
                                     dummy, dummy)
+    qparams = jax.jit(quantize_params)(variables["params"]) \
+        if quant_w else None
     S = cfg.block_size
     cache_len = S // 2
-    caches = init_cache(cfg, slots, S, dtype=dtype)
+    caches = init_cache(cfg, slots, S, dtype=cache_dtype)
     pos = jnp.full((slots,), cache_len, jnp.int32)
     tok = jnp.zeros((slots,), jnp.int32)
 
     @jax.jit
-    def step(variables, caches, tok, pos):
-        logits, _, caches = model.apply(variables, tok[:, None], None,
-                                        caches, pos, deterministic=True)
+    def step(variables, caches, tok, pos, qparams):
+        ctx = use_quantized_params(qparams) if qparams is not None \
+            else contextlib.nullcontext()
+        with ctx:
+            logits, _, caches = model.apply(variables, tok[:, None], None,
+                                            caches, pos, deterministic=True)
         nxt = jnp.argmax(logits[:, -1, :], -1).astype(jnp.int32)
         return caches, nxt, pos + 1
 
-    caches, tok, pos = step(variables, caches, tok, pos)  # compile + warmup
+    caches, tok, pos = step(variables, caches, tok, pos, qparams)  # compile
     jax.device_get(tok)
     t0 = time.perf_counter()
     for _ in range(iters):
-        caches, tok, pos = step(variables, caches, tok, pos)
+        caches, tok, pos = step(variables, caches, tok, pos, qparams)
     jax.device_get(tok)  # metrics-fetch sync (see time_variant note)
     dt = (time.perf_counter() - t0) / iters
     dsz = jnp.dtype(dtype).itemsize
-    bts = M.decode_step_bytes(cfg, slots, cache_len + iters // 2, dsz, dsz)
+    bts = M.decode_step_bytes(cfg, slots, cache_len + iters // 2, dsz,
+                              jnp.dtype(cache_dtype).itemsize,
+                              quant_weights=quant_w)
     bw = M.peak_hbm_bw_per_chip()
     mbu = bts / dt / bw if bw else float("nan")
     flash = os.environ.get("FLASH_DECODE", "auto")
     blk = os.environ.get("FLASH_DECODE_BLOCK", "512")
+    cd = jnp.dtype(cache_dtype).name
     print(f"decode slots={slots:4d} cache={cache_len:5d} flash={flash:4s} "
-          f"block={blk:>4s} | {dt * 1e3:7.2f} ms/step | "
+          f"block={blk:>4s} kv={cd:8s} qw={quant_w!s:5s} | "
+          f"{dt * 1e3:7.2f} ms/step | "
           f"{slots / dt:9.0f} tok/s | mbu {mbu:6.2%} | "
           f"{bts / 2 ** 20:6.0f} MiB/step [{preset}]", flush=True)
     return {"decode": True, "slots": slots, "ms": dt * 1e3, "mbu": mbu,
-            "flash_decode": flash, "block": blk, "preset": preset}
+            "flash_decode": flash, "block": blk, "preset": preset,
+            "cache_dtype": cd, "quant_w": quant_w}
 
 
 def time_variant(batch: int, attn_impl: str, act_recomp: bool,
@@ -318,18 +338,36 @@ def main():
         # (round 8): slot-count scaling (decode amortizes the weight read
         # over slots), split-KV tile ablation, and a ladder rung. The
         # printed column is MBU (memory-bandwidth utilization), not MFU.
+        # Round 9 adds the int8 column next to each bf16 leg: int8 KV
+        # (in-kernel dequant), weight-only int8, and both — the
+        # quantized-serving A/B that decides the QUANT_* auto defaults.
         D = {"SWEEP_DECODE": "1"}
+        I8 = {"SWEEP_CACHE_DTYPE": "int8"}
         grid = [
             (8, "auto", False, "fused", {**D, "FLASH_DECODE": "off"}),
             (8, "auto", False, "fused", {**D, "FLASH_DECODE": "on"}),
+            (8, "auto", False, "fused", {**D, **I8, "FLASH_DECODE": "on"}),
             (32, "auto", False, "fused", {**D, "FLASH_DECODE": "off"}),
             (32, "auto", False, "fused", {**D, "FLASH_DECODE": "on"}),
+            (32, "auto", False, "fused", {**D, **I8, "FLASH_DECODE": "off"}),
+            (32, "auto", False, "fused", {**D, **I8, "FLASH_DECODE": "on"}),
             (32, "auto", False, "fused", {**D, "FLASH_DECODE": "on",
+                                          "SWEEP_QUANT_W": "1"}),
+            (32, "auto", False, "fused", {**D, **I8, "FLASH_DECODE": "on",
+                                          "SWEEP_QUANT_W": "1"}),
+            (32, "auto", False, "fused", {**D, "FLASH_DECODE": "on",
+                                          "FLASH_DECODE_BLOCK": "256"}),
+            (32, "auto", False, "fused", {**D, **I8, "FLASH_DECODE": "on",
                                           "FLASH_DECODE_BLOCK": "256"}),
             (32, "auto", False, "fused", {**D, "FLASH_DECODE": "on",
                                           "FLASH_DECODE_BLOCK": "1024"}),
             (128, "auto", False, "fused", {**D, "FLASH_DECODE": "on"}),
+            (128, "auto", False, "fused", {**D, **I8, "FLASH_DECODE": "on",
+                                           "SWEEP_QUANT_W": "1"}),
             (8, "auto", False, "fused", {**D, "FLASH_DECODE": "on",
+                                         "SWEEP_PRESET": "gpt2_350m"}),
+            (8, "auto", False, "fused", {**D, **I8, "FLASH_DECODE": "on",
+                                         "SWEEP_QUANT_W": "1",
                                          "SWEEP_PRESET": "gpt2_350m"}),
         ]
     elif args.variants == "ladder":

@@ -111,6 +111,108 @@ def test_sdpa_routes_decode_through_kernel(monkeypatch):
                                atol=1e-5, rtol=1e-5)
 
 
+def _mk_q8(B, S, nh, nkv, hs, seed=0):
+    """Random decode shapes with an int8-quantized cache: returns the
+    quantized operands AND the dequantized reference K/V (what the kernel
+    must reproduce exactly — quantization error is not the kernel's)."""
+    from distributed_pytorch_tpu.ops.quant import dequantize_int8, quantize_kv
+    q, k, v = _mk(B, S, nh, nkv, hs, seed=seed)
+    kq, ks_ = quantize_kv(k)
+    vq, vs = quantize_kv(v)
+    kd = dequantize_int8(kq, ks_, q.dtype)
+    vd = dequantize_int8(vq, vs, q.dtype)
+    return q, kq, ks_, vq, vs, kd, vd
+
+
+@pytest.mark.parametrize("nkv", [8, 4, 2, 1], ids=lambda n: f"nkv{n}")
+def test_parity_int8_gqa_ratios(nkv):
+    """int8-cache kernel vs the naive path on the DEQUANTIZED cache:
+    <= 1e-5 for MHA through MQA at ragged per-sequence lengths — the
+    in-kernel dequant (scales folded into score/probability tiles) is
+    exact algebra, so the kernel owes the dequantized reference full
+    parity."""
+    B, S, nh, hs = 4, 64, 8, 16
+    q, kq, ks_, vq, vs, kd, vd = _mk_q8(B, S, nh, nkv, hs)
+    cl = jnp.array([1, 7, 33, 64], jnp.int32)
+    out = flash_decode(q[:, 0], kq, vq, cl, scale=hs ** -0.5,
+                       k_scale=ks_, v_scale=vs, interpret=True)
+    ref = _naive_sdpa(q, kd, vd, scale=hs ** -0.5, q_offset=cl - 1)[:, 0]
+    assert flash_decode_usable(q, kq, vq)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_parity_int8_block_split():
+    """Online max/sum merge across multiple int8 KV blocks (each with its
+    own scale rows) agrees with the single-pass softmax."""
+    B, S, nh, nkv, hs = 2, 256, 4, 2, 8
+    q, kq, ks_, vq, vs, kd, vd = _mk_q8(B, S, nh, nkv, hs, seed=3)
+    cl = jnp.array([100, 256], jnp.int32)
+    for block_s in (8, 32, 64):
+        out = flash_decode(q[:, 0], kq, vq, cl, scale=hs ** -0.5,
+                           k_scale=ks_, v_scale=vs, block_s=block_s,
+                           interpret=True)
+        ref = _naive_sdpa(q, kd, vd, scale=hs ** -0.5, q_offset=cl - 1)[:, 0]
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_sdpa_int8_kernel_vs_dequant_fallback(monkeypatch):
+    """The dispatcher's two int8 routes agree: FLASH_DECODE=on runs the
+    in-kernel-dequant path, FLASH_DECODE=off dequantizes up front and
+    takes the naive path — same cache, same answer."""
+    B, S, nh, nkv, hs = 3, 64, 8, 2, 16
+    q, kq, ks_, vq, vs, _, _ = _mk_q8(B, S, nh, nkv, hs, seed=11)
+    pos = jnp.array([4, 20, 63], jnp.int32)
+    monkeypatch.setenv("FLASH_DECODE", "on")
+    out = sdpa(q, kq, vq, causal=True, q_offset=pos, decode=True,
+               k_scale=ks_, v_scale=vs)
+    monkeypatch.setenv("FLASH_DECODE", "off")
+    ref = sdpa(q, kq, vq, causal=True, q_offset=pos, decode=True,
+               k_scale=ks_, v_scale=vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_int8_unsplittable_cache_falls_back(monkeypatch):
+    """quant_usable-style degrade: an int8 cache whose S the kernel cannot
+    tile (S=9) declines the kernel even under FLASH_DECODE=on and the
+    dequant+naive fallback carries the call — degrade, don't crash."""
+    from distributed_pytorch_tpu.ops.attention_core import _naive_sdpa
+    from distributed_pytorch_tpu.ops.quant import dequantize_int8, quantize_kv
+    B, S, nh, nkv, hs = 2, 9, 4, 2, 16
+    q, k, v = _mk(B, S, nh, nkv, hs, seed=7)
+    kq, ks_ = quantize_kv(k)
+    vq, vs = quantize_kv(v)
+    assert not flash_decode_usable(q, kq, vq)
+    pos = jnp.array([3, 8], jnp.int32)
+    monkeypatch.setenv("FLASH_DECODE", "on")
+    out = sdpa(q, kq, vq, causal=True, q_offset=pos, decode=True,
+               k_scale=ks_, v_scale=vs)
+    ref = _naive_sdpa(q, dequantize_int8(kq, ks_, q.dtype),
+                      dequantize_int8(vq, vs, q.dtype),
+                      scale=hs ** -0.5, q_offset=pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_int8_dead_slot_tail_blocks_fully_skipped():
+    """The int8 variant shares the cache_len block-skip: poisoned code/scale
+    rows past the valid block must not leak (NaN scales would propagate
+    through any touched lane)."""
+    from distributed_pytorch_tpu.ops.quant import quantize_kv
+    B, S, nh, nkv, hs = 1, 64, 4, 4, 8
+    q, k, v = _mk(B, S, nh, nkv, hs)
+    kq, ks_ = quantize_kv(k)
+    vq, vs = quantize_kv(v)
+    ks_ = ks_.at[:, 8:].set(jnp.nan)
+    vs = vs.at[:, 8:].set(jnp.inf)
+    cl = jnp.array([1], jnp.int32)
+    out = flash_decode(q[:, 0], kq, vq, cl, scale=hs ** -0.5,
+                       k_scale=ks_, v_scale=vs, block_s=8, interpret=True)
+    assert bool(jnp.isfinite(out).all())
+
+
 def test_sdpa_decode_scalar_offset_under_jit(monkeypatch):
     """The legacy generate loop's traced SCALAR position broadcasts to the
     per-sequence cache_len vector inside the dispatcher."""

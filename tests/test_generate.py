@@ -215,3 +215,31 @@ def test_sharded_sampling_cli(tmp_path, monkeypatch, capsys):
     # generated ids line: prompt + 8 new tokens
     last = [l for l in out.splitlines() if l.startswith("[")][-1]
     assert len(eval(last)) == 3 + 8
+
+
+def test_quantized_sampling_cli(tmp_path, monkeypatch, capsys):
+    """--cache-dtype int8 --quant-weights route the CLI through the
+    DecodeEngine's quantized serving path and the tok/s summary line says
+    so (round-9 satellite)."""
+    monkeypatch.chdir(tmp_path)
+    from distributed_pytorch_tpu.config import LLMConfig, TrainConfig
+    from distributed_pytorch_tpu.train.loop import train
+    from distributed_pytorch_tpu import sample
+    monkeypatch.setattr(sample, "_encoder", lambda: None)
+
+    mc = LLMConfig(vocab_size=256, block_size=32, n_embd=32, n_head=4,
+                   n_kv_heads=2, n_layer=2, up_dim=48)
+    tc = TrainConfig(dataset="synthetic", data_dir=str(tmp_path / "d"),
+                     total_batch_size=2 * 32, batch_size=2, max_iters=2,
+                     parallelism="single", save_model=True,
+                     save_stats=False, file_name="qrun")
+    train(mc, tc, log=lambda s: None)
+
+    sample.main(["--ckpt", "checkpoints/qrun", "--prompt", "1,2,3",
+                 "--max_new_tokens", "6", "--num_samples", "2",
+                 "--cache-dtype", "int8", "--quant-weights"])
+    out = capsys.readouterr().out
+    assert "cache=int8" in out and "quant_w=True" in out
+    lines = [l for l in out.splitlines() if l.startswith("[")]
+    assert len(lines) == 2
+    assert all(len(eval(l)) == 3 + 6 for l in lines)

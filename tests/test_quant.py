@@ -1,0 +1,336 @@
+"""Quantized serving (ops/quant.py): int8 round-trip error bounds, the
+pytree quantize/dequantize inverse, the matmul interception store, the
+QUANT_KV/QUANT_W gates, and the int8 DecodeEngine measured against the
+bf16 oracle on LOGITS (tokens can legitimately flip at a near-tie — the
+acceptance contract is a bounded logits divergence, not token equality)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pytorch_tpu.config import LLMConfig
+from distributed_pytorch_tpu.engine import DecodeEngine
+from distributed_pytorch_tpu.models.gpt import LLM, init_cache
+from distributed_pytorch_tpu.ops import quant
+
+
+def tiny_cfg(**kw):
+    base = dict(vocab_size=97, block_size=64, n_embd=48, n_head=4,
+                n_kv_heads=2, attn="gqa", n_layer=2, up_dim=64,
+                non_linearity="swiglu", pos_emb="rope", dropout=0.0,
+                q_latent_dim=16, kv_latent_dim=16, rope_head_dim=8)
+    base.update(kw)
+    return LLMConfig(**base)
+
+
+def build(cfg, seed=0):
+    model = LLM(cfg, attn_impl="naive")
+    rng = jax.random.PRNGKey(seed)
+    x = jnp.zeros((1, cfg.block_size), jnp.int32)
+    variables = model.init({"params": rng, "dropout": rng}, x, x)
+    return model, {k: v for k, v in variables.items()}
+
+
+# ---------------------------------------------------------------------------
+# core quantize / dequantize
+# ---------------------------------------------------------------------------
+
+def test_roundtrip_error_bound():
+    """Symmetric int8: |dequant(quant(x)) - x| <= scale/2 elementwise (half
+    a quantization step), with the group amax representable exactly."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (4, 3, 2, 16))
+    codes, scale = quant.quantize_int8(x, axis=-1)
+    assert codes.dtype == jnp.int8
+    assert scale.shape == (4, 3, 2, 1)
+    d = quant.dequantize_int8(codes, scale)
+    err = np.abs(np.asarray(d - x))
+    bound = np.asarray(scale) * 0.5 + 1e-7
+    assert (err <= bound).all()
+    # the per-group max hits the +-127 code exactly
+    amax = np.max(np.abs(np.asarray(x)), axis=-1)
+    np.testing.assert_allclose(
+        np.max(np.abs(np.asarray(d)), axis=-1), amax, rtol=1e-6)
+
+
+def test_zero_rows_stay_zero():
+    """All-zero groups (dead cache slots) get scale 0 and dequantize to
+    exact zeros — no NaN/inf from the guarded divide."""
+    x = jnp.zeros((2, 3, 2, 8))
+    codes, scale = quant.quantize_int8(x, axis=-1)
+    assert not np.asarray(codes).any()
+    d = quant.dequantize_int8(codes, scale)
+    assert np.isfinite(np.asarray(d)).all() and not np.asarray(d).any()
+
+
+def test_quantize_kv_shapes():
+    k = jax.random.normal(jax.random.PRNGKey(1), (3, 5, 2, 16))
+    codes, scale = quant.quantize_kv(k)
+    assert codes.shape == k.shape and codes.dtype == jnp.int8
+    assert scale.shape == (3, 5, 2, 1)
+
+
+# ---------------------------------------------------------------------------
+# pytree transforms + the interception store
+# ---------------------------------------------------------------------------
+
+def test_quantize_params_structure_and_inverse():
+    cfg = tiny_cfg()
+    _, variables = build(cfg)
+    params = variables["params"]
+    q = quant.quantize_params(params)
+    # matmul kernels are in, with codes int8 + f32 per-output-channel scale
+    leaf = q["block_0"]["attn"]["c_attn"]["kernel"]
+    assert leaf["q8"].dtype == jnp.int8
+    assert leaf["scale"].shape == (1, leaf["q8"].shape[1])
+    assert "embedding" in q["tkn_emb"]  # tied lm head, per-vocab-row scale
+    assert q["tkn_emb"]["embedding"]["scale"].shape == \
+        (params["tkn_emb"]["embedding"].shape[0], 1)
+    # biases / norms stay out (call sites keep bf16 for them)
+    assert "bias" not in q["block_0"]["attn"]["c_attn"]
+    assert "ln1" not in q["block_0"]
+    # dequantize_params is the inverse up to the quantization step
+    d = quant.dequantize_params(q)
+    w = params["block_0"]["attn"]["c_attn"]["kernel"]
+    step = np.asarray(q["block_0"]["attn"]["c_attn"]["kernel"]["scale"])
+    err = np.abs(np.asarray(d["block_0"]["attn"]["c_attn"]["kernel"]) -
+                 np.asarray(w))
+    assert (err <= step * 0.5 + 1e-7).all()
+
+
+def test_quantize_params_skips_expert_stacks():
+    cfg = tiny_cfg(moe=True, n_exp=4, n_shared=1, n_act=2, aux_free=True)
+    _, variables = build(cfg)
+    q = quant.quantize_params(variables["params"])
+    moe = q.get("block_0", {}).get("moe", {})
+    assert "experts_fc" not in moe and "experts_proj" not in moe
+
+
+def test_maybe_quantized_matmul_matches_dequant_reference():
+    """(x @ codes) * scale must equal x @ dequant(codes) — the scale is
+    per output channel, so the fold is exact algebra."""
+    w = jax.random.normal(jax.random.PRNGKey(2), (32, 24)) * 0.05
+    x = jax.random.normal(jax.random.PRNGKey(3), (4, 32))
+    store = {"lin": {"kernel": dict(zip(("q8", "scale"),
+                                        quant.quantize_int8(w, axis=0)))}}
+    with quant.use_quantized_params(store):
+        y = quant.maybe_quantized_matmul(x, ("lin", "kernel"))
+        assert quant.maybe_quantized_matmul(x, ("lin", "missing")) is None
+    assert quant.maybe_quantized_matmul(x, ("lin", "kernel")) is None  # inactive
+    ref = x @ quant.dequantize_int8(store["lin"]["kernel"]["q8"],
+                                    store["lin"]["kernel"]["scale"],
+                                    x.dtype)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_gate_resolution():
+    assert quant.resolve_gate("auto", True) and \
+        not quant.resolve_gate("auto", False)
+    assert quant.resolve_gate("on", False)
+    assert not quant.resolve_gate("off", True)
+    with pytest.raises(ValueError):
+        quant.resolve_gate("maybe", True)
+
+
+def test_quant_kv_usable_family():
+    assert quant.quant_kv_usable(tiny_cfg(attn="gqa"))
+    assert quant.quant_kv_usable(tiny_cfg(attn="mha"))
+    assert not quant.quant_kv_usable(tiny_cfg(attn="mla"))
+    with pytest.raises(ValueError):
+        init_cache(tiny_cfg(attn="mla"), 1, 16, dtype=jnp.int8)
+
+
+# ---------------------------------------------------------------------------
+# the int8 engine vs the bf16 oracle
+# ---------------------------------------------------------------------------
+
+PROMPTS = [[1, 2, 3], [5, 6, 7, 8, 9, 10, 11], [20] * 17, [42, 43]]
+
+
+def _teacher_forced_logits_err(model, variables, tokens, cache_dtype,
+                               qparams=None, n_steps=8):
+    """Max |logits_int8 - logits_f32| over a prefill + teacher-forced
+    decode of `tokens` — the engine's exact computation, oracle-fed so
+    both dtypes score identical inputs at every step."""
+    import contextlib
+    cfg = model.config
+    c_ref = init_cache(cfg, 1, cfg.block_size, dtype=jnp.float32)
+    c_q = init_cache(cfg, 1, cfg.block_size, dtype=cache_dtype)
+    p = jnp.asarray(tokens[:4], jnp.int32)[None]
+    ctx = (quant.use_quantized_params(qparams) if qparams is not None
+           else contextlib.nullcontext())
+    lf, _, c_ref = model.apply(variables, p, None, c_ref, 0,
+                               deterministic=True)
+    with ctx:
+        lq, _, c_q = model.apply(variables, p, None, c_q, 0,
+                                 deterministic=True)
+    errs = [float(jnp.max(jnp.abs(lf - lq)))]
+    pos = 4
+    for t in tokens[4:4 + n_steps]:
+        tt = jnp.asarray([[t]], jnp.int32)
+        lf, _, c_ref = model.apply(variables, tt, None, c_ref, pos,
+                                   deterministic=True)
+        ctx = (quant.use_quantized_params(qparams) if qparams is not None
+               else contextlib.nullcontext())
+        with ctx:
+            lq, _, c_q = model.apply(variables, tt, None, c_q, pos,
+                                     deterministic=True)
+        errs.append(float(jnp.max(jnp.abs(lf - lq))))
+        pos += 1
+    return max(errs)
+
+
+def test_int8_cache_logits_tolerance():
+    """int8 KV cache vs the f32 oracle, teacher-forced: the measured logits
+    divergence stays within a small tolerance of the logit scale (measured
+    ~1.5e-3 at this size; asserted with ~10x headroom)."""
+    cfg = tiny_cfg()
+    model, variables = build(cfg)
+    from distributed_pytorch_tpu.models.generate import generate
+    toks = generate(model, variables, jnp.asarray(PROMPTS[1], jnp.int32)[None],
+                    10, temperature=0.0)[0].tolist()
+    err = _teacher_forced_logits_err(model, variables, toks, jnp.int8)
+    assert err <= 2e-2, f"int8 cache logits diverged by {err}"
+
+
+def test_int8_weights_logits_tolerance():
+    """Weight-only int8 (decode matmuls on codes + scales) vs the bf16
+    oracle, teacher-forced on logits."""
+    cfg = tiny_cfg()
+    model, variables = build(cfg)
+    from distributed_pytorch_tpu.models.generate import generate
+    toks = generate(model, variables, jnp.asarray(PROMPTS[1], jnp.int32)[None],
+                    10, temperature=0.0)[0].tolist()
+    qparams = quant.quantize_params(variables["params"])
+    err = _teacher_forced_logits_err(model, variables, toks, jnp.float32,
+                                     qparams=qparams)
+    assert err <= 5e-2, f"int8 weights logits diverged by {err}"
+
+
+@pytest.mark.parametrize("kw", [dict(attn="gqa", n_kv_heads=2),
+                                dict(attn="mha"),
+                                dict(attn="mqa")], ids=["gqa", "mha", "mqa"])
+def test_int8_engine_runs_and_caches_are_int8(kw):
+    cfg = tiny_cfg(**kw)
+    model, variables = build(cfg)
+    eng = DecodeEngine(model, variables, n_slots=2, temperature=0.0,
+                       min_bucket=8, cache_dtype="int8",
+                       quantize_weights=True)
+    assert eng.kv_quantized and eng.weights_quantized
+    assert eng.caches[0]["k"].dtype == jnp.int8
+    assert eng.caches[0]["k_scale"].dtype == jnp.float32
+    ref = DecodeEngine(model, variables, n_slots=2, temperature=0.0,
+                       min_bucket=8)
+    outs = eng.run(PROMPTS, max_new_tokens=5)
+    refs = ref.run(PROMPTS, max_new_tokens=5)
+    # the quantized engine must preserve the serving contract (lengths,
+    # one step trace); token equality is NOT asserted — near-ties may flip
+    assert [len(o) for o in outs] == [len(r) for r in refs]
+    assert eng.step_traces == 1
+
+
+def test_int8_engine_mla_degrades_to_compute_dtype():
+    """cache_dtype='int8' on an MLA model falls back to bf16/f32 instead of
+    crashing (quant_kv_usable gate) — weight quantization still applies."""
+    cfg = tiny_cfg(attn="mla")
+    model, variables = build(cfg)
+    eng = DecodeEngine(model, variables, n_slots=2, temperature=0.0,
+                       min_bucket=8, cache_dtype="int8",
+                       quantize_weights=True)
+    assert not eng.kv_quantized
+    assert eng.caches[0]["c_kv"].dtype != jnp.int8
+    outs = eng.run(PROMPTS[:2], max_new_tokens=4)
+    assert [len(o) for o in outs] == [len(p) + 4 for p in PROMPTS[:2]]
+
+
+def test_int8_engine_tp_mesh_sharded_sidecars():
+    """int8 engine under a tensor-parallel CPU mesh: the scale sidecars'
+    kv-head axis shards over 'model' exactly like the code buffers
+    (decode_cache_pspec sees the (B, S, n_kv, 1) layout), and greedy
+    outputs match the unsharded int8 engine."""
+    from distributed_pytorch_tpu.parallel.mesh import mesh_for
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device CPU platform")
+    cfg = tiny_cfg()
+    model, variables = build(cfg)
+    ref = DecodeEngine(model, variables, n_slots=2, temperature=0.0,
+                       min_bucket=8, cache_dtype="int8",
+                       quantize_weights=True)
+    refs = ref.run(PROMPTS, max_new_tokens=5)
+    mesh = mesh_for("tp", tp_size=2)
+    eng = DecodeEngine(model, variables, n_slots=2, temperature=0.0,
+                       min_bucket=8, cache_dtype="int8",
+                       quantize_weights=True, mesh=mesh, recipe="tp")
+    assert eng.caches[0]["k"].sharding.spec[2] == "model"
+    assert eng.caches[0]["k_scale"].sharding.spec[2] == "model"
+    assert eng.run(PROMPTS, max_new_tokens=5) == refs
+
+
+def test_quant_kv_env_gate(monkeypatch):
+    """QUANT_KV=off pins bf16 despite an explicit int8 request; QUANT_KV=on
+    forces int8 without one (the bench A/B contract)."""
+    cfg = tiny_cfg()
+    model, variables = build(cfg)
+    monkeypatch.setenv("QUANT_KV", "off")
+    eng = DecodeEngine(model, variables, n_slots=1, cache_dtype="int8")
+    assert not eng.kv_quantized
+    monkeypatch.setenv("QUANT_KV", "on")
+    eng = DecodeEngine(model, variables, n_slots=1)
+    assert eng.kv_quantized
+    monkeypatch.setenv("QUANT_W", "on")
+    eng = DecodeEngine(model, variables, n_slots=1)
+    assert eng.weights_quantized
+
+
+# ---------------------------------------------------------------------------
+# serving-memory planning + bytes-model honesty
+# ---------------------------------------------------------------------------
+
+def test_serving_estimate_int8_smaller_and_slots_larger():
+    from distributed_pytorch_tpu.train.memplan import (estimate_serving_gb,
+                                                       plan_decode_slots)
+    # realistic head_size (64): the f32 scale sidecar is 4/(2*64) of the
+    # bf16 row, keeping the int8 cache just over half the bf16 bytes
+    cfg = tiny_cfg(n_embd=256, n_head=4, n_kv_heads=2)
+    bf16, bd16 = estimate_serving_gb(cfg, 32, cfg.block_size,
+                                     cache_dtype_size=2)
+    i8, bd8 = estimate_serving_gb(cfg, 32, cfg.block_size,
+                                  cache_dtype_size=1)
+    assert bd8["kv_cache"] < bd16["kv_cache"]
+    # ~2x fewer cache bytes (the f32 scale sidecars keep it just under 2x)
+    assert 0.5 <= bd8["kv_cache"] / bd16["kv_cache"] <= 0.6
+    # the quantized-weight copy ADDS memory (prefill keeps bf16 weights)
+    qw, bdq = estimate_serving_gb(cfg, 32, cfg.block_size,
+                                  cache_dtype_size=1, quantize_weights=True)
+    assert qw > i8
+    n16 = plan_decode_slots(cfg, cfg.block_size, hbm_gb=0.01,
+                            cache_dtype_size=2)
+    n8 = plan_decode_slots(cfg, cfg.block_size, hbm_gb=0.01,
+                           cache_dtype_size=1)
+    assert n8 >= n16 > 0
+
+
+def test_decode_step_bytes_true_itemsizes():
+    from distributed_pytorch_tpu.train import metrics as M
+    cfg = tiny_cfg(n_embd=256, n_head=4, n_kv_heads=2)  # head_size 64
+    bf16 = M.decode_step_bytes(cfg, 32, 512, 2, 2)
+    i8 = M.decode_step_bytes(cfg, 32, 512, 2, 1)
+    # cache component halves (+ scale sidecars): identical shapes, ~2x
+    # fewer cache bytes — the acceptance check
+    kv16 = 32 * 513 * M.kv_bytes_per_token(cfg, 2)
+    kv8 = 32 * 513 * M.kv_bytes_per_token(cfg, 1, kv_scales=True)
+    assert bf16 - i8 == kv16 - kv8
+    assert 0.5 <= kv8 / kv16 <= 0.6
+    # weight-only int8 more than halves the weight read (codes + scales)
+    qw = M.decode_step_bytes(cfg, 32, 512, 2, 1, quant_weights=True)
+    assert qw < i8
+    w16 = M.matmul_params_per_token(cfg) * 2
+    w8 = (M.quantized_matmul_params_per_token(cfg)
+          + M.quantized_matmul_out_channels(cfg) * 4)
+    assert (i8 - qw) == (w16 - w8)
+    # MoE: expert stacks stay at the bf16 price inside the quantized model
+    moe = tiny_cfg(moe=True, n_exp=4, n_shared=1, n_act=2, aux_free=True)
+    assert M.quantized_matmul_params_per_token(moe) < \
+        M.matmul_params_per_token(moe)

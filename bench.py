@@ -191,8 +191,8 @@ def _decode_bench(platform: str) -> dict:
 
     # ragged window: drain the full slots with random budgets via fresh
     # admissions as they retire; occupancy = mean live fraction
-    for slot in list(eng._slots):            # re-budget the live set
-        eng._slots[slot].max_new = int(npr.integers(ragged_lo, ragged_hi))
+    for sid in eng.live_seq_ids:             # re-budget the live set
+        eng.set_budget(sid, int(npr.integers(ragged_lo, ragged_hi)))
     queue = [(mk(), int(npr.integers(ragged_lo, ragged_hi)))
              for _ in range(slots)]
     live_steps, ragged_steps, ragged_toks = [], 0, 0
@@ -222,6 +222,131 @@ def _decode_bench(platform: str) -> dict:
             "ragged_occupancy": round(occupancy, 3),
             "mbu": round(mbu, 4) if mbu is not None else None,
             "n_slots": slots, "cache_len": S,
+            "flash_decode": os.environ.get("FLASH_DECODE", "auto"),
+            "cache_dtype": jnp.dtype(eng.cache_dtype).name,
+            "quant_w": eng.weights_quantized,
+            "n_chips": n_dev, "device": jax.devices()[0].device_kind,
+            "preset": preset}
+
+
+def _serve_bench(platform: str) -> dict:
+    """serve_load leg (BENCH_SERVE=1): seeded Poisson arrivals against the
+    async scheduler (serve/scheduler.py — no HTTP, so the number isolates
+    scheduling + engine, not socket parsing). Offered load is set ~1.3x
+    the probed steady service rate, so the queue genuinely fills: the leg
+    reports the latency SLO quantiles (TTFT/ITL p50/p99), delivered
+    tok/s/chip, shed rate at the admission bound, and mean slot occupancy
+    — the occupancy-vs-shed tradeoff the ROADMAP's serve A/B reads."""
+    import asyncio
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_pytorch_tpu.config import LLMConfig, flagship_gpt124m
+    from distributed_pytorch_tpu.engine import DecodeEngine
+    from distributed_pytorch_tpu.models.gpt import LLM
+    from distributed_pytorch_tpu.serve.scheduler import Scheduler, ShedError
+
+    n_dev = len(jax.devices())
+    if platform == "tpu":
+        cfg = flagship_gpt124m()
+        S = int(os.environ.get("BENCH_DECODE_LEN", "1024"))
+        slots = int(os.environ.get("BENCH_DECODE_SLOTS", "32"))
+        dtype = jnp.bfloat16
+        n_req, p_lo, p_hi, b_lo, b_hi = 192, 64, 512, 16, 96
+        preset = "gpt2_124m"
+    else:  # CPU proxy: tiny model so the harness still gets a line
+        cfg = LLMConfig(vocab_size=1024, block_size=128, n_embd=128,
+                        n_head=4, n_kv_heads=4, attn="mha", n_layer=2,
+                        up_dim=256, non_linearity="swiglu", pos_emb="rope")
+        S, slots, dtype = 128, 4, jnp.float32
+        n_req, p_lo, p_hi, b_lo, b_hi = 32, 4, 48, 4, 12
+        preset = "cpu_tiny"
+    model = LLM(cfg, compute_dtype=dtype, attn_impl="auto")
+    rng = jax.random.PRNGKey(0)
+    dummy = jnp.zeros((1, cfg.block_size), jnp.int32)
+    variables = jax.jit(model.init)({"params": rng, "dropout": rng},
+                                    dummy, dummy)
+    cache_dtype = os.environ.get("BENCH_CACHE_DTYPE", "") or None
+    quant_w = os.environ.get("BENCH_QUANT_W", "") == "1"
+    eng = DecodeEngine(model, variables, n_slots=slots, max_len=S,
+                       temperature=1.0, top_k=50,
+                       cache_dtype=cache_dtype, quantize_weights=quant_w)
+
+    npr = np.random.default_rng(0)
+    reqs = [(list(npr.integers(0, cfg.vocab_size,
+                               int(npr.integers(p_lo, p_hi)))),
+             int(npr.integers(b_lo, b_hi)))
+            for _ in range(n_req)]
+
+    # warm every prefill bucket + the fused step OUTSIDE the timed window
+    # (a 1-token budget retires at admission, freeing the slot instantly)
+    for bucket in sorted({eng.prefill_bucket(len(p)) for p, _ in reqs}):
+        eng.admit(list(npr.integers(0, cfg.vocab_size, bucket)), 1)
+    eng.admit(reqs[0][0], 2)
+    eng.step()
+
+    # probe the steady step time at full occupancy -> offered arrival rate
+    while eng.free_slots:
+        eng.admit(list(npr.integers(0, cfg.vocab_size, p_hi - 1)), 10 ** 9)
+    eng.step()
+    t0 = time.perf_counter()
+    probe_steps = 8
+    for _ in range(probe_steps):
+        eng.step()
+    jax.device_get(eng.tok)
+    step_s = (time.perf_counter() - t0) / probe_steps
+    for sid in eng.live_seq_ids:               # drain the probe set
+        eng.set_budget(sid, 1)
+    while eng.n_live:
+        eng.step()
+
+    mean_budget = (b_lo + b_hi) / 2
+    load_factor = float(os.environ.get("BENCH_SERVE_LOAD", "1.3"))
+    req_rate = slots / (mean_budget * step_s) * load_factor
+    gaps = npr.exponential(1.0 / req_rate, size=n_req)
+    arrivals = np.cumsum(gaps)
+
+    async def drive():
+        sched = Scheduler(eng, max_queue=2 * slots)
+        await sched.start()
+        consumers, shed = [], 0
+        start = time.perf_counter()
+        for (prompt, budget), at in zip(reqs, arrivals):
+            delay = start + at - time.perf_counter()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            try:
+                h = sched.submit(prompt, budget)
+            except ShedError:
+                shed += 1
+                continue
+            consumers.append(asyncio.ensure_future(h.result()))
+        await asyncio.gather(*consumers, return_exceptions=True)
+        dt = time.perf_counter() - start
+        await sched.stop()
+        return sched, shed, dt
+
+    sched, shed, dt = asyncio.run(drive())
+    s = sched.metrics.summary()
+    toks = sched.metrics.counters["tokens_out"]
+    return {"metric": ("serve_tokens_per_sec_per_chip" if platform == "tpu"
+                       else "cpu_proxy_serve_tokens_per_sec_per_chip"),
+            "value": round(toks / dt / n_dev, 1), "unit": "tok/s/chip",
+            "vs_baseline": 0,
+            "ttft_p50_ms": s["ttft"].get("p50_ms"),
+            "ttft_p99_ms": s["ttft"].get("p99_ms"),
+            "itl_p50_ms": s["itl"].get("p50_ms"),
+            "itl_p99_ms": s["itl"].get("p99_ms"),
+            "e2e_p50_ms": s["e2e"].get("p50_ms"),
+            "queue_wait_p99_ms": s["queue_wait"].get("p99_ms"),
+            "shed_rate": round(shed / n_req, 3),
+            "mean_occupancy": s["mean_occupancy"],
+            "probe_step_ms": round(step_s * 1e3, 2),
+            "offered_rps": round(req_rate, 2), "load_factor": load_factor,
+            "n_requests": n_req, "n_slots": slots, "cache_len": S,
             "flash_decode": os.environ.get("FLASH_DECODE", "auto"),
             "cache_dtype": jnp.dtype(eng.cache_dtype).name,
             "quant_w": eng.weights_quantized,
@@ -260,6 +385,12 @@ def run_bench(platform: str, only_recipe: str | None = None) -> dict:
     from distributed_pytorch_tpu.train.loop import train
 
     n_dev = len(jax.devices())
+
+    if os.environ.get("BENCH_SERVE"):
+        if platform == "tpu":
+            assert jax.default_backend() == "tpu", \
+                f"TPU probe passed but worker got {jax.default_backend()!r}"
+        return _serve_bench(platform)
 
     if os.environ.get("BENCH_DECODE"):
         if platform == "tpu":
@@ -528,7 +659,16 @@ def main() -> None:
                                      "BENCH_QUANT_W": "1"}),
                     ("decode_int8_kv", {"BENCH_DECODE": "1",
                                         "FLASH_DECODE": "on",
-                                        "BENCH_CACHE_DTYPE": "int8"})]:
+                                        "BENCH_CACHE_DTYPE": "int8"}),
+                    # round 10: online serving — Poisson load against the
+                    # async scheduler (TTFT/ITL quantiles, shed rate,
+                    # occupancy); bf16 and the round-9 int8 serving mix
+                    ("serve_load", {"BENCH_SERVE": "1",
+                                    "FLASH_DECODE": "on"}),
+                    ("serve_load_int8", {"BENCH_SERVE": "1",
+                                         "FLASH_DECODE": "on",
+                                         "BENCH_CACHE_DTYPE": "int8",
+                                         "BENCH_QUANT_W": "1"})]:
                 r = _spawn_worker("tpu", timeout_s=900, extra_env=env)
                 if r:
                     decode_results[name] = r

@@ -1,6 +1,10 @@
 """Serving-side decode engine: continuous batching over a slot-based KV
-cache. See engine/decode.py."""
+cache. See engine/decode.py; the async request scheduler + HTTP front-end
+above it live in serve/."""
 
-from distributed_pytorch_tpu.engine.decode import DecodeEngine
+from distributed_pytorch_tpu.engine.decode import (Admission, DecodeEngine,
+                                                   RETIRE_REASONS, Retired,
+                                                   StepResult)
 
-__all__ = ["DecodeEngine"]
+__all__ = ["DecodeEngine", "Admission", "Retired", "StepResult",
+           "RETIRE_REASONS"]

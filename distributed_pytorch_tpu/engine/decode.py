@@ -55,6 +55,42 @@ from distributed_pytorch_tpu.models.gpt import init_cache
 from distributed_pytorch_tpu.parallel import context
 
 
+#: Why a sequence left its slot — the serving layer routes on these.
+RETIRE_REASONS = ("eos", "budget", "cache_full", "cancelled")
+
+
+@dataclasses.dataclass
+class Retired:
+    """A finished sequence: its tokens (prompt + generated) and why it
+    stopped — 'eos' | 'budget' | 'cache_full' | 'cancelled'."""
+
+    tokens: list
+    reason: str
+    prompt_len: int
+
+
+@dataclasses.dataclass
+class Admission:
+    """What `admit()` hands back: the sequence id, the first sampled token
+    (prefill samples it — a streaming caller's TTFT token), and, for a
+    request that finished AT prefill (1-token budget, instant EOS), its
+    `Retired` record — such a request never appears in a later `step()`."""
+
+    seq_id: int
+    first_token: int
+    retired: Optional[Retired] = None
+
+
+@dataclasses.dataclass
+class StepResult:
+    """One fused step's host-visible output: `emitted` maps every sequence
+    that was live this step to the token it sampled (including sequences
+    retiring on that token); `retired` holds the subset that finished."""
+
+    emitted: dict
+    retired: dict
+
+
 @dataclasses.dataclass
 class _Slot:
     """Host-side bookkeeping for one occupied cache slot."""
@@ -80,9 +116,13 @@ class DecodeEngine:
     per-output-channel scales while prefill keeps bf16 — together ~1.9x
     fewer bytes per step at the bench decode shape (PERF.md round 9).
 
-    or stream it yourself: `admit()` until `free_slots` is empty, then
-    `step()` repeatedly — it returns `{seq_id: tokens}` for sequences that
-    finished this step.
+    or stream it yourself: `admit()` (returns an `Admission` with the
+    first sampled token) until `free_slots` is empty, then `step()`
+    repeatedly — each `StepResult` carries every live sequence's new token
+    plus `Retired` records (tokens + reason: eos | budget | cache_full)
+    for the ones that finished. `cancel(seq_id)` frees a slot mid-decode;
+    `n_free`/`occupancy`/`retire_counts` are the stable accounting surface
+    the serve/ scheduler reads (never the private `_slots`).
     """
 
     def __init__(self, model, variables: dict, *, n_slots: int = 8,
@@ -166,7 +206,6 @@ class DecodeEngine:
         self.live = jnp.zeros((n_slots,), bool)
 
         self._slots: dict[int, _Slot] = {}     # slot index -> bookkeeping
-        self._finished: dict[int, list] = {}   # seq_id -> tokens, undrained
         self._next_id = 0
         self._t = 0                            # global step counter (rng)
         self._n_admits = 0
@@ -177,6 +216,10 @@ class DecodeEngine:
         self._admit_fns: dict[int, Any] = {}
         self.step_traces = 0                   # test hook: must stay 1
         self.admit_traces: dict[int, int] = {}  # bucket -> trace count
+        # lifetime counters — the stable occupancy/accounting surface a
+        # scheduler reads instead of poking _slots
+        self.n_admitted = 0
+        self.retire_counts = dict.fromkeys(RETIRE_REASONS, 0)
 
     # ------------------------------------------------------------------
     # jitted device programs
@@ -255,16 +298,76 @@ class DecodeEngine:
     def n_live(self) -> int:
         return len(self._slots)
 
-    def _bucket(self, n: int) -> int:
+    @property
+    def n_free(self) -> int:
+        return self.n_slots - len(self._slots)
+
+    @property
+    def occupancy(self) -> float:
+        """Live fraction of the slot cache, 0.0..1.0."""
+        return len(self._slots) / self.n_slots
+
+    @property
+    def n_steps(self) -> int:
+        """Fused decode steps executed so far (serving tests bound slot
+        release latency in steps, not wall-clock)."""
+        return self._t
+
+    @property
+    def live_seq_ids(self) -> list[int]:
+        return [s.seq_id for s in self._slots.values()]
+
+    def set_budget(self, seq_id: int, max_new_tokens: int) -> None:
+        """Re-budget a live sequence (bench ragged windows re-arm the warm
+        slots this way instead of poking `_slots`)."""
+        for seq in self._slots.values():
+            if seq.seq_id == seq_id:
+                seq.max_new = max_new_tokens
+                return
+        raise KeyError(f"seq {seq_id} is not live")
+
+    def prefill_bucket(self, prompt_len: int) -> int:
+        """The power-of-two bucket a prompt of this length prefills in —
+        admissions sharing a bucket share one compiled prefill trace, so a
+        scheduler can group same-bucket prompts back-to-back."""
         b = self.min_bucket
-        while b < n:
+        while b < prompt_len:
             b *= 2
         return min(b, self.max_len)
 
+    def _retire_reason(self, slot: int, last_tok: int) -> Optional[str]:
+        seq = self._slots[slot]
+        if self.eos_id is not None and last_tok == self.eos_id:
+            return "eos"
+        if seq.n_new >= seq.max_new:
+            return "budget"
+        if seq.pos >= self.max_len:  # next write would wrap the ring
+            return "cache_full"
+        return None
+
+    def _retire(self, slot: int, reason: str) -> Retired:
+        seq = self._slots.pop(slot)
+        self.retire_counts[reason] += 1
+        return Retired(tokens=seq.tokens, reason=reason,
+                       prompt_len=seq.prompt_len)
+
+    def cancel(self, seq_id: int) -> Optional[Retired]:
+        """Free a live sequence's slot immediately (client disconnect).
+        Returns its partial `Retired(reason='cancelled')`, or None when the
+        id is not live (already retired — the token stream won the race)."""
+        for slot, seq in self._slots.items():
+            if seq.seq_id == seq_id:
+                ret = self._retire(slot, "cancelled")
+                self.live = self.live.at[slot].set(False)
+                return ret
+        return None
+
     def admit(self, prompt, max_new_tokens: int,
-              seq_id: Optional[int] = None) -> int:
-        """Prefill `prompt` (1D int sequence) into a free slot. Returns the
-        sequence id. Raises when no slot is free (check `free_slots`)."""
+              seq_id: Optional[int] = None) -> Admission:
+        """Prefill `prompt` (1D int sequence) into a free slot. Returns an
+        `Admission` (seq id + first sampled token + `retired` when the
+        request finished at prefill). Raises when no slot is free (check
+        `free_slots`)."""
         free = self.free_slots
         assert free, "no free slot — step()/retire before admitting"
         assert max_new_tokens >= 1
@@ -273,7 +376,7 @@ class DecodeEngine:
         # keep at least one free cache row to decode into
         toks = toks[-(self.max_len - 1):]
         L = len(toks)
-        bucket = self._bucket(L)
+        bucket = self.prefill_bucket(L)
         padded = jnp.asarray(toks + [0] * (bucket - L), jnp.int32)[None]
         if seq_id is None:
             seq_id = self._next_id
@@ -290,71 +393,73 @@ class DecodeEngine:
         self._slots[slot] = _Slot(seq_id=seq_id, tokens=toks + [first_tok],
                                   prompt_len=L, n_new=1,
                                   max_new=max_new_tokens, pos=L)
+        self.n_admitted += 1
         # a 1-token request (or instant EOS) finishes at admission
-        if self._maybe_retire(slot, first_tok):
+        retired = None
+        reason = self._retire_reason(slot, first_tok)
+        if reason is not None:
+            retired = self._retire(slot, reason)
             self.live = self.live.at[slot].set(False)
-        return seq_id
+        return Admission(seq_id=seq_id, first_token=first_tok,
+                         retired=retired)
 
-    def _maybe_retire(self, slot: int, last_tok: int) -> bool:
-        seq = self._slots[slot]
-        full = seq.pos >= self.max_len  # next write would wrap the ring
-        if (seq.n_new >= seq.max_new or full
-                or (self.eos_id is not None and last_tok == self.eos_id)):
-            self._finished[seq.seq_id] = seq.tokens
-            del self._slots[slot]
-            return True
-        return False
-
-    def step(self) -> dict[int, list]:
-        """Advance every live slot one token. Returns {seq_id: tokens} for
-        sequences that finished this step."""
+    def step(self) -> StepResult:
+        """Advance every live slot one token. Returns a `StepResult`:
+        {seq_id: token} sampled this step, plus {seq_id: Retired} for the
+        sequences that finished (with WHY — eos | budget | cache_full)."""
         if not self._slots:
-            return {}
+            return StepResult({}, {})
         with self._ctx():
             self.caches, self.tok, self.pos = self._get_step_fn()(
                 self.variables, self.caches, self.tok, self.pos, self.live,
                 self._rng, jnp.int32(self._t), self._qparams)
         self._t += 1
         sampled = jax.device_get(self.tok)
-        done: dict[int, list] = {}
-        retired = False
+        emitted: dict[int, int] = {}
+        retired: dict[int, Retired] = {}
         for slot in list(self._slots):
             seq = self._slots[slot]
             nxt = int(sampled[slot])
             seq.tokens.append(nxt)
             seq.n_new += 1
             seq.pos += 1
-            if self._maybe_retire(slot, nxt):
-                done[seq.seq_id] = seq.tokens
-                self._finished.pop(seq.seq_id, None)  # handed out here
-                retired = True
+            emitted[seq.seq_id] = nxt
+            reason = self._retire_reason(slot, nxt)
+            if reason is not None:
+                retired[seq.seq_id] = self._retire(slot, reason)
         # drop retired slots from the live mask (their device rows stay —
         # masked until the next occupant overwrites them)
         if retired:
             mask = np.zeros((self.n_slots,), bool)
             mask[list(self._slots)] = True
             self.live = jnp.asarray(mask)
-        return done
+        return StepResult(emitted=emitted, retired=retired)
 
-    def run(self, prompts, max_new_tokens: int,
+    def run(self, prompts, max_new_tokens,
             progress=None) -> list[list]:
         """Decode a whole batch of prompts with continuous batching: admit
         as slots free up, step until everything retires. Returns prompt +
-        generated tokens per input, in input order."""
-        pending = list(enumerate(prompts))
+        generated tokens per input, in input order. `max_new_tokens` is a
+        shared int or a per-prompt list (the serving parity tests replay
+        mixed budgets offline through this path)."""
+        budgets = (list(max_new_tokens)
+                   if isinstance(max_new_tokens, (list, tuple))
+                   else [max_new_tokens] * len(prompts))
+        assert len(budgets) == len(prompts)
+        pending = list(zip(range(len(prompts)), prompts, budgets))
         results: dict[int, list] = {}
         idx_for: dict[int, int] = {}
         while pending or self._slots:
             while pending and self.free_slots:
-                i, p = pending.pop(0)
-                idx_for[self.admit(p, max_new_tokens)] = i
+                i, p, b = pending.pop(0)
+                adm = self.admit(p, b)
+                idx_for[adm.seq_id] = i
+                if adm.retired is not None:  # finished at prefill
+                    results[i] = adm.retired.tokens
             t0 = time.perf_counter()
             if self._slots:
-                for sid, toks in self.step().items():
-                    results[idx_for[sid]] = toks
+                for sid, ret in self.step().retired.items():
+                    results[idx_for[sid]] = ret.tokens
             if progress is not None:
                 progress(self.n_live, time.perf_counter() - t0)
-            for sid in list(self._finished):  # retired at admission
-                if sid in idx_for:
-                    results[idx_for[sid]] = self._finished.pop(sid)
         return [results[i] for i in range(len(prompts))]

@@ -29,6 +29,87 @@ def _encoder():
         return None
 
 
+def load_for_inference(ckpt: str, *, shard: bool = False, log=print):
+    """Restore a trainer checkpoint for decoding; shared by this CLI and
+    the serving front-end (`python -m distributed_pytorch_tpu.serve`).
+
+    Returns `(model, variables, model_cfg, train_cfg, mesh, step)` —
+    `mesh` is None unless `shard` asked for (and the device count allows)
+    a sharded restore in the checkpoint's training-recipe layout. pp
+    checkpoints are unstacked into the loop model (pipeline doesn't
+    support KV caches); optimizer moments are never materialized."""
+    from distributed_pytorch_tpu.train import checkpoint as ckpt_mod
+    from distributed_pytorch_tpu.train.state import (build_model,
+                                                     init_train_state,
+                                                     make_optimizer)
+
+    path = ckpt
+    if not os.path.exists(os.path.join(path, "config.json")):
+        last = ckpt_mod.latest_step_dir(path)
+        assert last is not None, f"no checkpoint found under {path}"
+        path = last
+    model_cfg, train_cfg, step = ckpt_mod.load_configs(path)
+    log(f"loaded config from {path} (step {step}): "
+        f"{model_cfg.n_layer}L/{model_cfg.n_embd}d {model_cfg.attn}")
+
+    # Shapes only (jax.eval_shape): no concrete init of params or AdamW
+    # moments just to learn the checkpoint's structure; restore skips the
+    # optimizer moments entirely (placeholder leaves).
+    model = build_model(model_cfg, train_cfg)
+    tx = make_optimizer(train_cfg)
+    abstract = jax.eval_shape(
+        lambda r: init_train_state(r, model, model_cfg, tx,
+                                   batch_size=train_cfg.batch_size),
+        jax.random.PRNGKey(0))
+    shardings = None
+    mesh = None
+    if shard and len(jax.devices()) > 1:
+        from distributed_pytorch_tpu.parallel.mesh import mesh_for
+        from distributed_pytorch_tpu.train.state import (state_shardings,
+                                                         state_spec_tree)
+        mesh = mesh_for(train_cfg.parallelism, tp_size=train_cfg.tp_size,
+                        ep_size=train_cfg.ep_size, sp_size=train_cfg.sp_size,
+                        pp_size=train_cfg.pp_size)
+        spec_tree = state_spec_tree(abstract, train_cfg.parallelism, mesh)
+        shardings = state_shardings(abstract, train_cfg.parallelism, mesh)
+        from jax.sharding import PartitionSpec as P
+        n_sharded = sum(
+            1 for s in jax.tree_util.tree_leaves(
+                spec_tree.params, is_leaf=lambda x: isinstance(x, P))
+            if any(a is not None for a in s))
+        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        if n_sharded:
+            log(f"sharded restore: mesh {sizes}, {n_sharded} param "
+                f"leaves sharded ({train_cfg.parallelism} layout)")
+        else:
+            log(f"--shard: recipe {train_cfg.parallelism!r} replicates "
+                "all params — restore is NOT memory-sharded (use an "
+                "fsdp/tp/pp checkpoint for models larger than one "
+                "device)")
+    state = ckpt_mod.restore_for_inference(path, abstract, shardings)
+    params = state.params
+    if model_cfg.pp_stages > 1:
+        # pipeline checkpoints store the blocks stacked on a layer axis;
+        # decoding runs the loop model, so unstack and rebuild
+        # (models/pipeline.py — pp doesn't support KV caches itself)
+        from distributed_pytorch_tpu.models.pipeline import \
+            unstack_block_params
+        params = unstack_block_params(params, model_cfg.n_layer)
+        if state.moe_state:
+            # the aux-free bias is layer-stacked under pp too
+            state = dataclasses.replace(
+                state, moe_state=unstack_block_params(state.moe_state,
+                                                      model_cfg.n_layer))
+        model_cfg = dataclasses.replace(model_cfg, pp_stages=1,
+                                        pp_microbatches=0)
+        model = build_model(model_cfg, train_cfg)
+        log("pp checkpoint: unstacked block params for decoding")
+    variables = {"params": params}
+    if state.moe_state:
+        variables["moe_state"] = state.moe_state
+    return model, variables, model_cfg, train_cfg, mesh, step
+
+
 def main(argv=None) -> None:
     p = argparse.ArgumentParser(description="Sample from a trained checkpoint")
     p.add_argument("--ckpt", type=str, required=True,
@@ -60,74 +141,9 @@ def main(argv=None) -> None:
     args = p.parse_args(argv)
 
     from distributed_pytorch_tpu.models.generate import make_generate_fn
-    from distributed_pytorch_tpu.train import checkpoint as ckpt
-    from distributed_pytorch_tpu.train.state import (build_model,
-                                                     init_train_state,
-                                                     make_optimizer)
 
-    path = args.ckpt
-    if not os.path.exists(os.path.join(path, "config.json")):
-        last = ckpt.latest_step_dir(path)
-        assert last is not None, f"no checkpoint found under {path}"
-        path = last
-    model_cfg, train_cfg, step = ckpt.load_configs(path)
-    print(f"loaded config from {path} (step {step}): "
-          f"{model_cfg.n_layer}L/{model_cfg.n_embd}d {model_cfg.attn}")
-
-    # Shapes only (jax.eval_shape): no concrete init of params or AdamW
-    # moments just to learn the checkpoint's structure; restore skips the
-    # optimizer moments entirely (placeholder leaves).
-    model = build_model(model_cfg, train_cfg)
-    tx = make_optimizer(train_cfg)
-    abstract = jax.eval_shape(
-        lambda r: init_train_state(r, model, model_cfg, tx,
-                                   batch_size=train_cfg.batch_size),
-        jax.random.PRNGKey(0))
-    shardings = None
-    mesh = None
-    if args.shard and len(jax.devices()) > 1:
-        from distributed_pytorch_tpu.parallel.mesh import mesh_for
-        from distributed_pytorch_tpu.train.state import (state_shardings,
-                                                         state_spec_tree)
-        mesh = mesh_for(train_cfg.parallelism, tp_size=train_cfg.tp_size,
-                        ep_size=train_cfg.ep_size, sp_size=train_cfg.sp_size,
-                        pp_size=train_cfg.pp_size)
-        spec_tree = state_spec_tree(abstract, train_cfg.parallelism, mesh)
-        shardings = state_shardings(abstract, train_cfg.parallelism, mesh)
-        from jax.sharding import PartitionSpec as P
-        n_sharded = sum(
-            1 for s in jax.tree_util.tree_leaves(
-                spec_tree.params, is_leaf=lambda x: isinstance(x, P))
-            if any(a is not None for a in s))
-        sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-        if n_sharded:
-            print(f"sharded restore: mesh {sizes}, {n_sharded} param "
-                  f"leaves sharded ({train_cfg.parallelism} layout)")
-        else:
-            print(f"--shard: recipe {train_cfg.parallelism!r} replicates "
-                  "all params — restore is NOT memory-sharded (use an "
-                  "fsdp/tp/pp checkpoint for models larger than one "
-                  "device)")
-    state = ckpt.restore_for_inference(path, abstract, shardings)
-    params = state.params
-    if model_cfg.pp_stages > 1:
-        # pipeline checkpoints store the blocks stacked on a layer axis;
-        # decoding runs the loop model, so unstack and rebuild
-        # (models/pipeline.py — pp doesn't support KV caches itself)
-        from distributed_pytorch_tpu.models.pipeline import unstack_block_params
-        params = unstack_block_params(params, model_cfg.n_layer)
-        if state.moe_state:
-            # the aux-free bias is layer-stacked under pp too
-            state = dataclasses.replace(
-                state, moe_state=unstack_block_params(state.moe_state,
-                                                      model_cfg.n_layer))
-        model_cfg = dataclasses.replace(model_cfg, pp_stages=1,
-                                        pp_microbatches=0)
-        model = build_model(model_cfg, train_cfg)
-        print("pp checkpoint: unstacked block params for decoding")
-    variables = {"params": params}
-    if state.moe_state:
-        variables["moe_state"] = state.moe_state
+    model, variables, model_cfg, train_cfg, mesh, _ = load_for_inference(
+        args.ckpt, shard=args.shard)
 
     enc = _encoder()
     if enc is not None:

@@ -1,0 +1,12 @@
+"""Online serving layer over engine/DecodeEngine: asyncio request
+scheduler (scheduler.py), stdlib streaming HTTP front-end (server.py),
+and serve-side metrics (metrics.py). Start a server with
+`python -m distributed_pytorch_tpu.serve --ckpt <dir>`."""
+
+from distributed_pytorch_tpu.serve.metrics import ServeMetrics
+from distributed_pytorch_tpu.serve.scheduler import (RequestHandle,
+                                                     Scheduler, ShedError)
+from distributed_pytorch_tpu.serve.server import ServeApp
+
+__all__ = ["Scheduler", "RequestHandle", "ShedError", "ServeMetrics",
+           "ServeApp"]

@@ -1,0 +1,124 @@
+"""Serving CLI: `python -m distributed_pytorch_tpu.serve --ckpt <dir>`.
+
+Loads a trainer checkpoint (same restore path as sample.py, including
+`--shard` for mesh-sharded models and pp unstacking), builds a
+`DecodeEngine` (+ the round-9 int8 knobs), wraps it in the async
+scheduler, and serves `POST /v1/completions` (SSE streaming), `/healthz`
+and `/metrics` until interrupted. `--demo` starts a tiny random-init
+model instead — no checkpoint needed, for smoke tests
+(scripts/serve_smoke.sh) and CI.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+
+import jax
+
+
+def build_args(argv=None):
+    p = argparse.ArgumentParser(
+        description="Streaming HTTP serving over the DecodeEngine")
+    src = p.add_mutually_exclusive_group(required=True)
+    src.add_argument("--ckpt", type=str,
+                     help="checkpoint dir (trainer layout; the newest "
+                          "step is used when given the run root)")
+    src.add_argument("--demo", action="store_true",
+                     help="serve a tiny random-init model (no checkpoint; "
+                          "token-id prompts only) — smoke tests")
+    p.add_argument("--host", type=str, default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000,
+                   help="0 picks an ephemeral port (printed at startup)")
+    p.add_argument("--slots", type=int, default=8,
+                   help="decode slots (size with "
+                        "train.memplan.plan_decode_slots)")
+    p.add_argument("--max-queue", type=int, default=128,
+                   help="admission queue bound; overflow is shed as 429")
+    p.add_argument("--deadline-s", type=float, default=None,
+                   help="default per-request queue-wait deadline")
+    p.add_argument("--max-tokens-default", type=int, default=64)
+    p.add_argument("--temperature", type=float, default=0.8)
+    p.add_argument("--top_k", "--top-k", dest="top_k", type=int, default=50)
+    p.add_argument("--eos-id", type=int, default=None,
+                   help="retire sequences on this token (GPT-2: 50256)")
+    p.add_argument("--seed", type=int, default=1729)
+    p.add_argument("--shard", action="store_true",
+                   help="sharded restore in the training recipe's layout")
+    p.add_argument("--cache-dtype", "--cache_dtype", dest="cache_dtype",
+                   default="", choices=["", "int8", "bfloat16", "float32"])
+    p.add_argument("--quant-weights", "--quant_weights",
+                   dest="quant_weights", action="store_true")
+    return p.parse_args(argv)
+
+
+def _demo_model():
+    from distributed_pytorch_tpu.config import LLMConfig
+    from distributed_pytorch_tpu.models.gpt import LLM
+    import jax.numpy as jnp
+    cfg = LLMConfig(vocab_size=1024, block_size=256, n_embd=128, n_head=4,
+                    n_kv_heads=4, attn="mha", n_layer=2, up_dim=256,
+                    non_linearity="swiglu", pos_emb="rope")
+    model = LLM(cfg, attn_impl="auto")
+    rng = jax.random.PRNGKey(0)
+    dummy = jnp.zeros((1, cfg.block_size), jnp.int32)
+    variables = jax.jit(model.init)({"params": rng, "dropout": rng},
+                                    dummy, dummy)
+    return model, dict(variables), None, "single"
+
+
+async def _amain(args) -> None:
+    from distributed_pytorch_tpu.engine import DecodeEngine
+    from distributed_pytorch_tpu.serve.scheduler import Scheduler
+    from distributed_pytorch_tpu.serve.server import ServeApp
+
+    if args.demo:
+        model, variables, mesh, recipe = _demo_model()
+        encoder = None
+        print("demo mode: tiny random-init model, token-id prompts only")
+    else:
+        from distributed_pytorch_tpu.sample import _encoder, \
+            load_for_inference
+        model, variables, _, train_cfg, mesh, _ = load_for_inference(
+            args.ckpt, shard=args.shard)
+        recipe = train_cfg.parallelism if mesh is not None else "single"
+        encoder = _encoder()
+
+    eng = DecodeEngine(model, variables, n_slots=args.slots,
+                       cache_dtype=args.cache_dtype or None,
+                       quantize_weights=args.quant_weights,
+                       temperature=args.temperature, top_k=args.top_k,
+                       eos_id=args.eos_id,
+                       rng=jax.random.PRNGKey(args.seed),
+                       mesh=mesh, recipe=recipe)
+    sched = Scheduler(eng, max_queue=args.max_queue,
+                      default_deadline_s=args.deadline_s)
+    app = ServeApp(sched, host=args.host, port=args.port, encoder=encoder,
+                   default_max_tokens=args.max_tokens_default)
+    await sched.start()
+    await app.start()
+    print(f"serving on http://{args.host}:{app.port} "
+          f"(slots={args.slots}, queue<={args.max_queue}, "
+          f"cache={'int8' if eng.kv_quantized else 'native'}, "
+          f"quant_w={eng.weights_quantized})")
+    print(f"  curl -N -X POST http://{args.host}:{app.port}/v1/completions "
+          "-d '{\"prompt\": [1, 2, 3], \"max_tokens\": 16}'")
+    try:
+        await app.serve_forever()
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        await app.stop()
+        await sched.stop()
+
+
+def main(argv=None) -> None:
+    args = build_args(argv)
+    try:
+        asyncio.run(_amain(args))
+    except KeyboardInterrupt:
+        print("\nshutting down")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,193 @@
+"""Serve-side observability: latency histograms, lifecycle counters, and
+live gauges, rendered two ways — Prometheus text (`/metrics`) and a JSON
+summary (the bench `serve_load` leg).
+
+The quantities mirror the serving literature's decode SLOs (Orca/vLLM,
+PAPERS.md): **TTFT** (submit -> first token; = queue wait + bucketed
+prefill), **ITL** (gap between consecutive streamed tokens; = one fused
+engine step when the scheduler keeps up), **e2e** latency, plus queue
+depth / slot occupancy and admitted/completed/cancelled/shed counters —
+the pair of curves (occupancy up, shed rate up) the admission bound
+trades between.
+
+Design notes:
+* Histograms keep BOTH Prometheus cumulative bucket counts (cheap,
+  mergeable, what scrapers want) and a capped reservoir of raw samples so
+  the bench leg reports exact p50/p99 instead of bucket-edge estimates
+  (exact until `max_samples` observations; the cap only bounds memory on
+  a long-lived server — CI/bench runs never reach it).
+* No locks: every observation comes from the scheduler's event loop (the
+  engine runs in an executor, but its results are consumed back on the
+  loop), and `/metrics` renders on the same loop. Single-threaded by
+  construction, like the rest of the asyncio front-end.
+* stdlib only — the CI image needs no prometheus_client.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+# Decode SLOs span ~1 ms (one fused step) to minutes (a queued long
+# prompt), so the default grid is log-ish across that range, in seconds.
+LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+
+class Histogram:
+    """Prometheus-style cumulative histogram + exact quantiles."""
+
+    def __init__(self, name: str, help_: str,
+                 buckets=LATENCY_BUCKETS, max_samples: int = 65536):
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(sorted(buckets))
+        self.counts = [0] * (len(self.buckets) + 1)  # last = +Inf
+        self.sum = 0.0
+        self.count = 0
+        self._samples: list[float] = []
+        self._max_samples = max_samples
+
+    def observe(self, v: float) -> None:
+        self.sum += v
+        self.count += 1
+        for i, edge in enumerate(self.buckets):
+            if v <= edge:
+                self.counts[i] += 1
+                break
+        else:
+            self.counts[-1] += 1
+        if len(self._samples) < self._max_samples:
+            self._samples.append(v)
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Exact quantile over the retained samples (None when empty)."""
+        if not self._samples:
+            return None
+        s = sorted(self._samples)
+        idx = min(len(s) - 1, max(0, round(q * (len(s) - 1))))
+        return s[idx]
+
+    @property
+    def max(self) -> Optional[float]:
+        return max(self._samples) if self._samples else None
+
+    def render(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} histogram"]
+        cum = 0
+        for edge, c in zip(self.buckets, self.counts):
+            cum += c
+            lines.append(f'{self.name}_bucket{{le="{edge}"}} {cum}')
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {self.count}')
+        lines.append(f"{self.name}_sum {self.sum}")
+        lines.append(f"{self.name}_count {self.count}")
+        return lines
+
+    def summary(self) -> dict:
+        """p50/p99/max/mean in milliseconds for the bench leg JSON."""
+        if not self.count:
+            return {"count": 0}
+        ms = 1e3
+        return {"count": self.count,
+                "p50_ms": round((self.quantile(0.50) or 0.0) * ms, 3),
+                "p99_ms": round((self.quantile(0.99) or 0.0) * ms, 3),
+                "max_ms": round((self.max or 0.0) * ms, 3),
+                "mean_ms": round(self.sum / self.count * ms, 3)}
+
+
+class ServeMetrics:
+    """The scheduler/server's shared metrics registry."""
+
+    #: request lifecycle counters; 'shed' splits by cause in shed_counts
+    COUNTERS = ("submitted", "admitted", "completed", "cancelled", "shed",
+                "tokens_out")
+
+    def __init__(self):
+        self.ttft = Histogram(
+            "serve_ttft_seconds",
+            "submit to first streamed token (queue wait + bucketed prefill)")
+        self.itl = Histogram(
+            "serve_itl_seconds",
+            "inter-token latency (one fused decode step when not queued)")
+        self.e2e = Histogram(
+            "serve_e2e_seconds", "submit to retirement")
+        self.queue_wait = Histogram(
+            "serve_queue_wait_seconds", "submit to slot admission")
+        self.counters = dict.fromkeys(self.COUNTERS, 0)
+        self.shed_counts: dict[str, int] = {}     # cause -> n
+        self.retire_counts: dict[str, int] = {}   # reason -> n
+        self._occ_sum = 0.0
+        self._occ_n = 0
+        self._gauges: dict[str, tuple[Callable[[], float], str]] = {}
+
+    # ------------------------------------------------------------------
+    def inc(self, name: str, n: int = 1) -> None:
+        self.counters[name] += n
+
+    def shed(self, cause: str) -> None:
+        self.counters["shed"] += 1
+        self.shed_counts[cause] = self.shed_counts.get(cause, 0) + 1
+
+    def retired(self, reason: str) -> None:
+        self.retire_counts[reason] = self.retire_counts.get(reason, 0) + 1
+
+    def observe_occupancy(self, frac: float) -> None:
+        """Record the live-slot fraction seen by one fused step."""
+        self._occ_sum += frac
+        self._occ_n += 1
+
+    @property
+    def mean_occupancy(self) -> float:
+        return self._occ_sum / self._occ_n if self._occ_n else 0.0
+
+    def register_gauge(self, name: str, fn: Callable[[], float],
+                       help_: str = "") -> None:
+        """Register a live-read gauge (queue depth, slot occupancy)."""
+        self._gauges[name] = (fn, help_)
+
+    # ------------------------------------------------------------------
+    def render_prometheus(self) -> str:
+        """The `/metrics` payload (Prometheus text exposition 0.0.4)."""
+        lines: list[str] = []
+        for h in (self.ttft, self.itl, self.e2e, self.queue_wait):
+            lines += h.render()
+        lines += ["# HELP serve_requests_total request lifecycle counters",
+                  "# TYPE serve_requests_total counter"]
+        for name in ("submitted", "admitted", "completed", "cancelled",
+                     "shed"):
+            lines.append(f'serve_requests_total{{event="{name}"}} '
+                         f'{self.counters[name]}')
+        for cause, n in sorted(self.shed_counts.items()):
+            lines.append(f'serve_shed_total{{cause="{cause}"}} {n}')
+        for reason, n in sorted(self.retire_counts.items()):
+            lines.append(f'serve_retired_total{{reason="{reason}"}} {n}')
+        lines += ["# HELP serve_tokens_streamed_total tokens fanned out",
+                  "# TYPE serve_tokens_streamed_total counter",
+                  f"serve_tokens_streamed_total "
+                  f"{self.counters['tokens_out']}",
+                  "# HELP serve_slot_occupancy_mean mean live-slot "
+                  "fraction over all fused steps",
+                  "# TYPE serve_slot_occupancy_mean gauge",
+                  f"serve_slot_occupancy_mean {self.mean_occupancy:.4f}"]
+        for name, (fn, help_) in sorted(self._gauges.items()):
+            if help_:
+                lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} gauge")
+            try:
+                lines.append(f"{name} {float(fn())}")
+            except Exception:  # pragma: no cover — gauge died mid-shutdown
+                lines.append(f"{name} NaN")
+        return "\n".join(lines) + "\n"
+
+    def summary(self) -> dict:
+        """Flat dict for the bench `serve_load` leg JSON."""
+        out = {"ttft": self.ttft.summary(), "itl": self.itl.summary(),
+               "e2e": self.e2e.summary(),
+               "queue_wait": self.queue_wait.summary(),
+               "mean_occupancy": round(self.mean_occupancy, 4)}
+        out.update(self.counters)
+        if self.shed_counts:
+            out["shed_by_cause"] = dict(self.shed_counts)
+        if self.retire_counts:
+            out["retired_by_reason"] = dict(self.retire_counts)
+        return out

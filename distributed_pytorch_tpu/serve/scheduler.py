@@ -1,0 +1,386 @@
+"""Async request scheduler: the online layer that keeps the DecodeEngine's
+slot cache full under ragged arrivals.
+
+Orca-style continuous batching (PAPERS.md) only pays off when admissions
+and retirements interleave with decoding — the round-8 engine gives the
+device side (one fused step for every live slot, O(1) slot reuse); this
+module gives the host side:
+
+* **Bounded FCFS admission queue**: `submit()` either enqueues or raises
+  `ShedError` — backpressure is an explicit error at the edge, never a
+  silent drop or an unbounded queue. Per-request `deadline_s` bounds the
+  QUEUE WAIT: a request that can't reach a slot in time is shed with a
+  'deadline' cause instead of burning a slot on an answer nobody is
+  waiting for.
+* **Bucket-grouped admission waves**: each scheduling pass fills every
+  free slot from the queue head (FCFS — a stream of short requests can
+  never starve an earlier long one, the property tests/test_serve.py
+  pins). WITHIN a wave, prompts are stably sorted by their pow2 prefill
+  bucket so same-bucket prefills run back-to-back on one compiled trace
+  (`DecodeEngine.prefill_bucket`; the engine compiles one prefill per
+  bucket, so grouping maximizes warm-trace reuse without reordering
+  across waves).
+* **One background step loop**: a single task owns the engine; every
+  engine call (admit/step) runs in a one-thread executor so a ~ms fused
+  step never blocks the event loop's HTTP writes. Tokens fan out to
+  per-request `asyncio.Queue` streams (`RequestHandle` async-iterates
+  them); retirement reasons ride the final event.
+* **Cancellation**: `RequestHandle.cancel()` (the server calls it on
+  client disconnect) flags the request; the loop applies
+  `engine.cancel()` before the next step, so a cancelled request's slot
+  is free within one fused step. Queued requests are cancelled in place
+  without ever touching the engine.
+
+Threading contract: `submit`/`cancel` must be called on the event loop
+(the HTTP server does); only the background loop touches the engine, and
+it serializes admits/steps through the executor, so the engine never sees
+concurrent calls.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import collections
+import concurrent.futures
+import dataclasses
+import time
+from typing import Optional
+
+from distributed_pytorch_tpu.engine.decode import Retired
+from distributed_pytorch_tpu.serve.metrics import ServeMetrics
+
+
+class ShedError(RuntimeError):
+    """Admission control rejected/evicted the request (queue_full |
+    deadline | shutdown). Surfaces as HTTP 429/503 — never a hang."""
+
+    def __init__(self, cause: str, msg: str):
+        super().__init__(msg)
+        self.cause = cause
+
+
+@dataclasses.dataclass
+class _Request:
+    prompt: list
+    max_new: int
+    deadline_s: Optional[float]
+    submitted_at: float
+    handle: "RequestHandle"
+    seq_id: Optional[int] = None
+    admitted_at: Optional[float] = None
+    last_tok_at: Optional[float] = None
+    cancelled: bool = False
+
+
+class RequestHandle:
+    """Caller-side view of one request: async-iterate the generated token
+    ids as they stream; `cancel()` to abandon; `await result()` to drain
+    to the final `Retired` record.
+
+    >>> handle = scheduler.submit(prompt_ids, max_new_tokens=64)
+    >>> async for tok in handle: ...
+    >>> handle.retired.reason   # 'eos' | 'budget' | 'cache_full' | ...
+    """
+
+    def __init__(self, scheduler: "Scheduler", req: "_Request"):
+        self._scheduler = scheduler
+        self._req = req
+        self._events: asyncio.Queue = asyncio.Queue()
+        self.tokens: list[int] = []        # generated tokens streamed so far
+        self.retired: Optional[Retired] = None
+        self.error: Optional[BaseException] = None
+
+    # -- scheduler side -------------------------------------------------
+    def _push_token(self, tok: int) -> None:
+        self._events.put_nowait(("token", tok))
+
+    def _push_done(self, ret: Retired) -> None:
+        self.retired = ret
+        self._events.put_nowait(("done", ret))
+
+    def _push_error(self, exc: BaseException) -> None:
+        self.error = exc
+        self._events.put_nowait(("error", exc))
+
+    # -- caller side ----------------------------------------------------
+    @property
+    def submitted_at(self) -> float:
+        return self._req.submitted_at
+
+    @property
+    def admitted_at(self) -> Optional[float]:
+        """perf_counter timestamp of slot admission (None while queued)."""
+        return self._req.admitted_at
+
+    def cancel(self) -> None:
+        """Abandon the request. A queued request shreds in place; a live
+        one has its slot freed before the next fused step."""
+        self._scheduler._request_cancel(self._req)
+
+    def __aiter__(self) -> "RequestHandle":
+        return self
+
+    async def __anext__(self) -> int:
+        while True:
+            if self._events.empty():
+                if self.retired is not None or self.error is not None:
+                    raise StopAsyncIteration
+            kind, val = await self._events.get()
+            if kind == "token":
+                self.tokens.append(val)
+                return val
+            if kind == "error":
+                raise val
+            raise StopAsyncIteration          # kind == "done"
+
+    async def result(self) -> Retired:
+        """Drain the stream; return the final `Retired` (raises the shed /
+        scheduler error when the request never finished)."""
+        async for _ in self:
+            pass
+        assert self.retired is not None
+        return self.retired
+
+
+class Scheduler:
+    """Owns a `DecodeEngine` and serves it to concurrent async callers.
+
+    >>> sched = Scheduler(engine, max_queue=128)
+    >>> await sched.start()
+    >>> handle = sched.submit([1, 2, 3], max_new_tokens=32)
+    >>> async for tok in handle: ...
+    >>> await sched.stop()
+    """
+
+    def __init__(self, engine, *, max_queue: int = 128,
+                 metrics: Optional[ServeMetrics] = None,
+                 default_deadline_s: Optional[float] = None):
+        self.engine = engine
+        self.max_queue = max_queue
+        self.metrics = metrics if metrics is not None else ServeMetrics()
+        self.default_deadline_s = default_deadline_s
+        self._queue: collections.deque[_Request] = collections.deque()
+        self._live: dict[int, _Request] = {}       # seq_id -> request
+        self._cancel_live: list[_Request] = []     # applied between steps
+        self._wake = asyncio.Event()
+        self._exec = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="decode")
+        self._task: Optional[asyncio.Task] = None
+        self._stopping = False
+        self.metrics.register_gauge(
+            "serve_queue_depth", lambda: len(self._queue),
+            "requests waiting for a slot")
+        self.metrics.register_gauge(
+            "serve_slot_occupancy", lambda: self.engine.occupancy,
+            "live fraction of the engine's slot cache")
+        self.metrics.register_gauge(
+            "serve_slots_free", lambda: self.engine.n_free,
+            "free decode slots")
+
+    # ------------------------------------------------------------------
+    # caller API (event-loop thread only)
+    # ------------------------------------------------------------------
+
+    async def start(self) -> None:
+        assert self._task is None, "scheduler already started"
+        self._task = asyncio.create_task(self._run(), name="serve-scheduler")
+
+    async def stop(self) -> None:
+        """Cancel live requests, shed queued ones, stop the loop."""
+        self._stopping = True
+        self._wake.set()
+        if self._task is not None:
+            await asyncio.gather(self._task, return_exceptions=True)
+            self._task = None
+        self._exec.shutdown(wait=True)
+
+    def submit(self, prompt, max_new_tokens: int, *,
+               deadline_s: Optional[float] = None) -> RequestHandle:
+        """Enqueue a request (FCFS). Raises `ShedError` immediately when
+        the admission queue is at its bound or the scheduler is stopping —
+        backpressure is explicit, the caller maps it to HTTP 429/503."""
+        if self._stopping:
+            raise ShedError("shutdown", "scheduler is stopping")
+        self.metrics.inc("submitted")
+        if len(self._queue) >= self.max_queue:
+            self.metrics.shed("queue_full")
+            raise ShedError(
+                "queue_full",
+                f"admission queue at bound ({self.max_queue}); retry later")
+        if deadline_s is None:
+            deadline_s = self.default_deadline_s
+        req = _Request(prompt=[int(t) for t in prompt],
+                       max_new=max_new_tokens, deadline_s=deadline_s,
+                       submitted_at=time.perf_counter(), handle=None)
+        req.handle = RequestHandle(self, req)
+        self._queue.append(req)
+        self._wake.set()
+        return req.handle
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._queue)
+
+    @property
+    def n_live(self) -> int:
+        return len(self._live)
+
+    # ------------------------------------------------------------------
+    # internals (background loop)
+    # ------------------------------------------------------------------
+
+    def _request_cancel(self, req: _Request) -> None:
+        if req.cancelled or req.handle.retired is not None \
+                or req.handle.error is not None:
+            return
+        req.cancelled = True
+        if req.seq_id is None:                 # still queued: shed in place
+            try:
+                self._queue.remove(req)
+            except ValueError:                 # admission wave won the race
+                pass
+            else:
+                self.metrics.inc("cancelled")
+                req.handle._push_done(Retired(
+                    tokens=list(req.prompt), reason="cancelled",
+                    prompt_len=len(req.prompt)))
+                return
+        self._cancel_live.append(req)
+        self._wake.set()
+
+    def _apply_cancellations(self) -> None:
+        """Free cancelled live slots NOW (before the next fused step)."""
+        for req in self._cancel_live:
+            if req.seq_id is None:             # flagged pre-admission but
+                continue                       # the wave admitted it: next
+            ret = self.engine.cancel(req.seq_id)
+            self._live.pop(req.seq_id, None)
+            self.metrics.inc("cancelled")
+            if ret is None:                    # retired before we got here
+                continue
+            self.metrics.retired("cancelled")
+            req.handle._push_done(ret)
+        # keep not-yet-admitted flagged requests for the next pass (the
+        # admission wave resolves them); drop anything already finished
+        self._cancel_live = [r for r in self._cancel_live
+                             if r.seq_id is None
+                             and r.handle.retired is None
+                             and r.handle.error is None]
+
+    def _shed_expired(self, now: float) -> None:
+        """Evict queued requests whose deadline passed (never a live one —
+        its tokens are already streaming)."""
+        keep: collections.deque[_Request] = collections.deque()
+        for req in self._queue:
+            if req.deadline_s is not None \
+                    and now - req.submitted_at > req.deadline_s:
+                self.metrics.shed("deadline")
+                req.handle._push_error(ShedError(
+                    "deadline",
+                    f"queued {now - req.submitted_at:.3f}s > deadline "
+                    f"{req.deadline_s:.3f}s"))
+            else:
+                keep.append(req)
+        self._queue = keep
+
+    async def _admit_wave(self, loop) -> None:
+        """Fill every free slot from the queue head. FCFS across waves;
+        within the wave a stable bucket sort makes same-bucket prompts
+        prefill consecutively on one compiled trace."""
+        n = min(self.engine.n_free, len(self._queue))
+        if not n:
+            return
+        wave = [self._queue.popleft() for _ in range(n)]
+        wave.sort(key=lambda r: self.engine.prefill_bucket(
+            min(len(r.prompt), self.engine.max_len - 1)))
+        for req in wave:
+            if req.cancelled:
+                self.metrics.inc("cancelled")
+                req.handle._push_done(Retired(
+                    tokens=list(req.prompt), reason="cancelled",
+                    prompt_len=len(req.prompt)))
+                continue
+            adm = await loop.run_in_executor(
+                self._exec, self.engine.admit, req.prompt, req.max_new)
+            now = time.perf_counter()
+            req.seq_id = adm.seq_id
+            req.admitted_at = now
+            req.last_tok_at = now
+            self.metrics.inc("admitted")
+            self.metrics.queue_wait.observe(now - req.submitted_at)
+            self.metrics.ttft.observe(now - req.submitted_at)
+            self.metrics.inc("tokens_out")
+            req.handle._push_token(adm.first_token)
+            if adm.retired is not None:        # finished at prefill
+                self._finish(req, adm.retired, now)
+            else:
+                self._live[adm.seq_id] = req
+
+    def _finish(self, req: _Request, ret: Retired, now: float) -> None:
+        self.metrics.inc("completed")
+        self.metrics.retired(ret.reason)
+        self.metrics.e2e.observe(now - req.submitted_at)
+        req.handle._push_done(ret)
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        try:
+            while True:
+                now = time.perf_counter()
+                self._apply_cancellations()
+                self._shed_expired(now)
+                if self._stopping:
+                    break
+                await self._admit_wave(loop)
+                if not self._live:
+                    if not self._queue:        # idle: park until work
+                        self._wake.clear()
+                        # re-check under the cleared flag (submit() may
+                        # have landed between the test and the clear)
+                        if not self._queue and not self._cancel_live \
+                                and not self._stopping:
+                            await self._wake.wait()
+                    continue
+                # admissions may have taken a while — free freshly
+                # cancelled slots before paying for a step
+                self._apply_cancellations()
+                if not self._live:
+                    continue
+                self.metrics.observe_occupancy(self.engine.occupancy)
+                res = await loop.run_in_executor(self._exec,
+                                                 self.engine.step)
+                now = time.perf_counter()
+                for sid, tok in res.emitted.items():
+                    req = self._live.get(sid)
+                    if req is None:            # cancelled mid-flight
+                        continue
+                    self.metrics.itl.observe(now - req.last_tok_at)
+                    req.last_tok_at = now
+                    self.metrics.inc("tokens_out")
+                    req.handle._push_token(tok)
+                for sid, ret in res.retired.items():
+                    req = self._live.pop(sid, None)
+                    if req is not None:
+                        self._finish(req, ret, now)
+                # one cooperative yield so consumers drain between steps
+                await asyncio.sleep(0)
+        except Exception as exc:               # crash guard: error, not hang
+            for req in list(self._live.values()) + list(self._queue):
+                req.handle._push_error(exc)
+            self._live.clear()
+            self._queue.clear()
+            raise
+        finally:
+            # shutdown: cancel live slots, shed whatever is still queued
+            for req in list(self._live.values()):
+                ret = self.engine.cancel(req.seq_id)
+                self.metrics.inc("cancelled")
+                if ret is not None:
+                    self.metrics.retired("cancelled")
+                    req.handle._push_done(ret)
+            self._live.clear()
+            for req in self._queue:
+                self.metrics.shed("shutdown")
+                req.handle._push_error(
+                    ShedError("shutdown", "scheduler stopped"))
+            self._queue.clear()

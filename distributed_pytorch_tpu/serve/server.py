@@ -1,0 +1,266 @@
+"""Streaming HTTP front-end over the async scheduler — stdlib asyncio
+only, so CI and air-gapped images need no web framework.
+
+Endpoints:
+* `POST /v1/completions` — body `{"prompt": [ids] | "text",
+  "max_tokens": N, "stream": true, "deadline_s": s}`. With
+  `stream` (the default) the response is Server-Sent Events: one
+  `data: {"token": id[, "text": piece]}` event per generated token, a
+  final `data: {"done": true, "reason": ...}`, then `data: [DONE]`.
+  `stream: false` collects and returns one JSON body. String prompts
+  need tiktoken (the prepare scripts' GPT-2 BPE); token-id lists always
+  work. Queue-full / deadline shed maps to HTTP 429 — backpressure is an
+  explicit status, never a hang.
+* `GET /healthz` — liveness + a queue/slot snapshot.
+* `GET /metrics` — Prometheus text exposition (serve/metrics.py).
+
+Client disconnects matter at decode timescales: a dropped SSE consumer
+must not hold a slot for its remaining budget. The completion handler
+watches the connection's read side concurrently with the token stream —
+EOF (close/reset) cancels the request, and the scheduler frees the slot
+before the next fused step.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import Optional
+
+from distributed_pytorch_tpu.serve.scheduler import (RequestHandle,
+                                                     Scheduler, ShedError)
+
+_MAX_HEADER_BYTES = 64 * 1024
+_MAX_BODY_BYTES = 8 * 1024 * 1024
+
+
+def _response(status: int, body: bytes, content_type: str,
+              extra: str = "") -> bytes:
+    reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
+              405: "Method Not Allowed", 413: "Payload Too Large",
+              429: "Too Many Requests", 500: "Internal Server Error",
+              503: "Service Unavailable"}.get(status, "OK")
+    return (f"HTTP/1.1 {status} {reason}\r\n"
+            f"Content-Type: {content_type}\r\n"
+            f"Content-Length: {len(body)}\r\n"
+            f"Connection: close\r\n{extra}\r\n").encode() + body
+
+
+def _json_response(status: int, obj: dict) -> bytes:
+    return _response(status, json.dumps(obj).encode(), "application/json")
+
+
+class ServeApp:
+    """Bind a `Scheduler` to a localhost HTTP port.
+
+    >>> app = ServeApp(scheduler, port=0)       # 0 = ephemeral (tests)
+    >>> await app.start(); print(app.port)
+    >>> await app.stop()
+    """
+
+    def __init__(self, scheduler: Scheduler, *, host: str = "127.0.0.1",
+                 port: int = 8000, encoder=None,
+                 default_max_tokens: int = 64):
+        self.scheduler = scheduler
+        self.host = host
+        self.port = port
+        self.encoder = encoder            # tiktoken-like, or None (ids only)
+        self.default_max_tokens = default_max_tokens
+        self._server: Optional[asyncio.base_events.Server] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def serve_forever(self) -> None:
+        assert self._server is not None, "call start() first"
+        async with self._server:
+            await self._server.serve_forever()
+
+    # ------------------------------------------------------------------
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        try:
+            head = await reader.readuntil(b"\r\n\r\n")
+        except (asyncio.IncompleteReadError, asyncio.LimitOverrunError,
+                ConnectionError):
+            writer.close()
+            return
+        try:
+            if len(head) > _MAX_HEADER_BYTES:
+                writer.write(_json_response(413, {"error": "headers too "
+                                                           "large"}))
+                return
+            request_line, *header_lines = head.decode(
+                "latin-1").split("\r\n")
+            parts = request_line.split(" ")
+            if len(parts) < 2:
+                writer.write(_json_response(400, {"error": "bad request"}))
+                return
+            method, path = parts[0].upper(), parts[1].split("?")[0]
+            headers = {}
+            for line in header_lines:
+                if ":" in line:
+                    k, v = line.split(":", 1)
+                    headers[k.strip().lower()] = v.strip()
+
+            if method == "GET" and path == "/healthz":
+                writer.write(self._healthz())
+            elif method == "GET" and path == "/metrics":
+                body = self.scheduler.metrics.render_prometheus().encode()
+                writer.write(_response(
+                    200, body, "text/plain; version=0.0.4; charset=utf-8"))
+            elif method == "POST" and path == "/v1/completions":
+                await self._completions(reader, writer, headers)
+            elif path in ("/healthz", "/metrics", "/v1/completions"):
+                writer.write(_json_response(405, {"error": "method not "
+                                                           "allowed"}))
+            else:
+                writer.write(_json_response(404, {"error": "not found"}))
+            await writer.drain()
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            writer.close()
+
+    def _healthz(self) -> bytes:
+        eng = self.scheduler.engine
+        return _json_response(200, {
+            "ok": True, "live_slots": eng.n_live, "free_slots": eng.n_free,
+            "queue_depth": self.scheduler.queue_depth,
+            "n_slots": eng.n_slots})
+
+    # ------------------------------------------------------------------
+
+    async def _completions(self, reader, writer, headers) -> None:
+        try:
+            n = int(headers.get("content-length", "0"))
+        except ValueError:
+            writer.write(_json_response(400, {"error": "bad "
+                                                       "content-length"}))
+            return
+        if n > _MAX_BODY_BYTES:
+            writer.write(_json_response(413, {"error": "body too large"}))
+            return
+        try:
+            body = json.loads((await reader.readexactly(n)) or b"{}")
+        except (json.JSONDecodeError, asyncio.IncompleteReadError):
+            writer.write(_json_response(400, {"error": "invalid JSON "
+                                                       "body"}))
+            return
+
+        prompt = body.get("prompt")
+        if isinstance(prompt, str):
+            if self.encoder is None:
+                writer.write(_json_response(
+                    400, {"error": "no tokenizer available; send 'prompt' "
+                                   "as a list of token ids"}))
+                return
+            prompt = self.encoder.encode(prompt, allowed_special="all")
+        if not isinstance(prompt, list) or not prompt \
+                or not all(isinstance(t, int) for t in prompt):
+            writer.write(_json_response(
+                400, {"error": "'prompt' must be a non-empty list of "
+                               "token ids (or text with a tokenizer)"}))
+            return
+        max_tokens = int(body.get("max_tokens", self.default_max_tokens))
+        if max_tokens < 1:
+            writer.write(_json_response(400, {"error": "max_tokens must "
+                                                       "be >= 1"}))
+            return
+        deadline = body.get("deadline_s")
+        stream = bool(body.get("stream", True))
+
+        try:
+            handle = self.scheduler.submit(
+                prompt, max_tokens,
+                deadline_s=float(deadline) if deadline is not None
+                else None)
+        except ShedError as e:
+            writer.write(_json_response(
+                429 if e.cause == "queue_full" else 503,
+                {"error": str(e), "cause": e.cause}))
+            return
+
+        if stream:
+            await self._stream_sse(reader, writer, handle)
+        else:
+            try:
+                ret = await handle.result()
+            except ShedError as e:
+                writer.write(_json_response(429, {"error": str(e),
+                                                  "cause": e.cause}))
+                return
+            writer.write(_json_response(200, {
+                "tokens": ret.tokens[ret.prompt_len:],
+                "text": self._decode(ret.tokens[ret.prompt_len:]),
+                "reason": ret.reason, "n_prompt": ret.prompt_len}))
+
+    def _decode(self, toks: list[int]) -> Optional[str]:
+        if self.encoder is None:
+            return None
+        try:
+            return self.encoder.decode(toks)
+        except Exception:
+            return None
+
+    async def _stream_sse(self, reader, writer,
+                          handle: RequestHandle) -> None:
+        writer.write(b"HTTP/1.1 200 OK\r\n"
+                     b"Content-Type: text/event-stream\r\n"
+                     b"Cache-Control: no-cache\r\n"
+                     b"Connection: close\r\n\r\n")
+        # The disconnect watch: the client sends nothing after the POST
+        # body, so a completed read means EOF/reset -> the consumer is
+        # gone -> cancel so the slot frees before the next fused step.
+        eof_task = asyncio.ensure_future(reader.read(1))
+        next_tok: Optional[asyncio.Future] = None
+        try:
+            while True:
+                next_tok = asyncio.ensure_future(handle.__anext__())
+                done, _ = await asyncio.wait(
+                    {next_tok, eof_task},
+                    return_when=asyncio.FIRST_COMPLETED)
+                if eof_task in done:
+                    handle.cancel()
+                    next_tok.cancel()
+                    return
+                try:
+                    tok = next_tok.result()
+                except StopAsyncIteration:
+                    break
+                except ShedError as e:
+                    writer.write(self._sse({"error": str(e),
+                                            "cause": e.cause}))
+                    await writer.drain()
+                    return
+                event = {"token": tok}
+                piece = self._decode([tok])
+                if piece is not None:
+                    event["text"] = piece
+                writer.write(self._sse(event))
+                await writer.drain()
+            ret = handle.retired
+            writer.write(self._sse({"done": True, "reason": ret.reason,
+                                    "n_tokens": len(handle.tokens)}))
+            writer.write(b"data: [DONE]\n\n")
+            await writer.drain()
+        except (ConnectionError, asyncio.CancelledError):
+            handle.cancel()
+            raise
+        finally:
+            eof_task.cancel()
+            if next_tok is not None:
+                next_tok.cancel()
+
+    @staticmethod
+    def _sse(obj: dict) -> bytes:
+        return f"data: {json.dumps(obj)}\n\n".encode()

@@ -1,0 +1,276 @@
+"""Async scheduler (serve/scheduler.py) over the DecodeEngine: FCFS
+no-starvation, bucket-grouped admission waves, mid-decode cancellation
+freeing the slot within a step, bounded-queue shed (an error, never a
+hang), queue-wait deadlines, and stream parity with the offline engine."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_pytorch_tpu.config import LLMConfig
+from distributed_pytorch_tpu.engine import DecodeEngine
+from distributed_pytorch_tpu.models.gpt import LLM
+from distributed_pytorch_tpu.serve.scheduler import Scheduler, ShedError
+
+
+def tiny_cfg(**kw):
+    base = dict(vocab_size=97, block_size=64, n_embd=48, n_head=4,
+                n_kv_heads=2, attn="gqa", n_layer=2, up_dim=64,
+                non_linearity="swiglu", pos_emb="rope", dropout=0.0)
+    base.update(kw)
+    return LLMConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def mv():
+    cfg = tiny_cfg()
+    model = LLM(cfg, attn_impl="naive")
+    rng = jax.random.PRNGKey(0)
+    x = jnp.zeros((1, cfg.block_size), jnp.int32)
+    variables = dict(model.init({"params": rng, "dropout": rng}, x, x))
+    return cfg, model, variables
+
+
+def run_async(coro, timeout=300):
+    """Every test is wrapped in a hard timeout: a scheduler bug must fail
+    the test, not hang the suite (and CI's serve step runs under its own
+    `timeout` for the same reason)."""
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+def make_engine(mv, n_slots=2, **kw):
+    _, model, variables = mv
+    kw.setdefault("temperature", 0.0)
+    kw.setdefault("min_bucket", 8)
+    return DecodeEngine(model, variables, n_slots=n_slots, **kw)
+
+
+# ----------------------------------------------------------------------
+# FCFS / starvation
+# ----------------------------------------------------------------------
+
+def test_fcfs_no_starvation_behind_short_stream(mv):
+    """A queued long request is admitted in submission order even while a
+    stream of later short requests keeps arriving — FCFS means nothing
+    starves."""
+
+    async def main():
+        eng = make_engine(mv, n_slots=1)
+        sched = Scheduler(eng, max_queue=32)
+        await sched.start()
+        first = sched.submit([1, 2, 3], 2)
+        long = sched.submit([4, 5, 6], 8)
+        shorts = [sched.submit([7 + i], 2) for i in range(5)]
+        handles = [first, long] + shorts
+        await asyncio.gather(*(h.result() for h in handles))
+        await sched.stop()
+        return eng, sched, handles
+
+    eng, sched, handles = run_async(main())
+    admits = [h.admitted_at for h in handles]
+    assert all(a is not None for a in admits), "a request starved"
+    # single slot + same bucket for everyone: admission order must equal
+    # submission order — in particular the long request admitted before
+    # every short submitted after it
+    assert admits == sorted(admits)
+    assert all(h.retired.reason == "budget" for h in handles)
+    # "max wait bounded": the whole run bounds every queue wait
+    assert sched.metrics.queue_wait.max < 300
+    assert sched.metrics.counters["admitted"] == len(handles)
+    assert sched.metrics.counters["shed"] == 0
+
+
+def test_admission_wave_groups_by_prefill_bucket(mv):
+    """Within one admission wave, prompts are grouped by pow2 bucket so
+    same-bucket prefills run back-to-back on one compiled trace; across
+    the wave nothing is reordered beyond that (stable sort)."""
+
+    async def main():
+        eng = make_engine(mv, n_slots=4)
+        sched = Scheduler(eng, max_queue=8)
+        # queue BEFORE starting the loop: one wave admits all four
+        h_big1 = sched.submit(list(range(1, 18)), 2)    # bucket 32
+        h_small1 = sched.submit([1, 2, 3], 2)           # bucket 8
+        h_big2 = sched.submit(list(range(1, 21)), 2)    # bucket 32
+        h_small2 = sched.submit([4, 5], 2)              # bucket 8
+        await sched.start()
+        handles = [h_big1, h_small1, h_big2, h_small2]
+        await asyncio.gather(*(h.result() for h in handles))
+        await sched.stop()
+        return eng, handles
+
+    eng, (h_big1, h_small1, h_big2, h_small2) = run_async(main())
+    # both bucket-8 prefills ran before both bucket-32 prefills
+    assert max(h_small1.admitted_at, h_small2.admitted_at) \
+        < min(h_big1.admitted_at, h_big2.admitted_at)
+    # stable within a bucket: submission order preserved
+    assert h_small1.admitted_at < h_small2.admitted_at
+    assert h_big1.admitted_at < h_big2.admitted_at
+    assert set(eng.admit_traces) == {8, 32}
+    assert set(eng.admit_traces.values()) == {1}
+
+
+# ----------------------------------------------------------------------
+# cancellation
+# ----------------------------------------------------------------------
+
+def test_cancel_mid_decode_frees_slot_within_one_step(mv):
+    async def main():
+        eng = make_engine(mv, n_slots=1)
+        sched = Scheduler(eng, max_queue=8)
+        await sched.start()
+        h = sched.submit([1, 2, 3], 40)
+        got = []
+        async for tok in h:
+            got.append(tok)
+            if len(got) == 3:
+                break
+        steps_at_cancel = eng.n_steps
+        h.cancel()
+        ret = await h.result()
+        steps_done = eng.n_steps
+        # the slot must be reusable immediately: a fresh request decodes
+        b = sched.submit([9, 8, 7], 2)
+        await b.result()
+        await sched.stop()
+        return eng, h, ret, got, steps_at_cancel, steps_done, b
+
+    eng, h, ret, got, s0, s1, b = run_async(main())
+    assert ret.reason == "cancelled"
+    # the loop free-runs, so one step may be in flight when cancel lands
+    # and one more may start before the flag is applied — but never the
+    # remaining ~37 steps of budget
+    assert s1 - s0 <= 2, f"cancel took {s1 - s0} steps to free the slot"
+    assert eng.retire_counts["cancelled"] == 1
+    assert len(h.tokens) < 10          # nowhere near the 40-token budget
+    assert b.retired.reason == "budget"
+    assert eng.n_live == 0
+
+
+def test_cancel_while_queued_never_touches_engine(mv):
+    async def main():
+        eng = make_engine(mv, n_slots=1)
+        sched = Scheduler(eng, max_queue=8)
+        await sched.start()
+        a = sched.submit([1, 2, 3], 30)
+        await a.__anext__()                       # a holds the only slot
+        q = sched.submit([4, 5], 10)              # parked in the queue
+        q.cancel()
+        ret = await q.result()
+        a.cancel()
+        await a.result()
+        await sched.stop()
+        return eng, sched, ret, q
+
+    eng, sched, ret, q = run_async(main())
+    assert ret.reason == "cancelled"
+    assert q.admitted_at is None                  # never reached a slot
+    assert eng.n_admitted == 1                    # only a touched the engine
+    assert sched.metrics.counters["cancelled"] == 2
+
+
+# ----------------------------------------------------------------------
+# backpressure: bounded queue + deadlines, shed is an error not a hang
+# ----------------------------------------------------------------------
+
+def test_queue_bound_sheds_immediately(mv):
+    async def main():
+        eng = make_engine(mv, n_slots=1)
+        sched = Scheduler(eng, max_queue=2)
+        await sched.start()
+        a = sched.submit([1, 2, 3], 40)
+        await a.__anext__()                       # admitted: queue empty
+        b = sched.submit([4], 2)
+        c = sched.submit([5], 2)
+        with pytest.raises(ShedError) as ei:
+            sched.submit([6], 2)
+        a.cancel()
+        await asyncio.gather(a.result(), b.result(), c.result())
+        await sched.stop()
+        return sched, ei.value
+
+    sched, err = run_async(main())
+    assert err.cause == "queue_full"
+    assert sched.metrics.counters["shed"] == 1
+    assert sched.metrics.shed_counts == {"queue_full": 1}
+    # the two queued requests still completed (bound ≠ starvation)
+    assert sched.metrics.counters["completed"] == 2
+
+
+def test_deadline_shed_surfaces_as_error(mv):
+    async def main():
+        eng = make_engine(mv, n_slots=1)
+        sched = Scheduler(eng, max_queue=8)
+        await sched.start()
+        a = sched.submit([1, 2, 3], 30)
+        await a.__anext__()
+        b = sched.submit([4, 5], 10, deadline_s=0.0)  # can't make it
+        with pytest.raises(ShedError) as ei:
+            await b.result()
+        a.cancel()
+        await a.result()
+        await sched.stop()
+        return sched, ei.value
+
+    sched, err = run_async(main())
+    assert err.cause == "deadline"
+    assert sched.metrics.shed_counts.get("deadline") == 1
+
+
+def test_stop_sheds_queued_and_cancels_live(mv):
+    async def main():
+        eng = make_engine(mv, n_slots=1)
+        sched = Scheduler(eng, max_queue=8)
+        await sched.start()
+        a = sched.submit([1, 2, 3], 40)
+        await a.__anext__()
+        b = sched.submit([4, 5], 10)              # still queued
+        await sched.stop()
+        assert a.retired is not None and a.retired.reason == "cancelled"
+        with pytest.raises(ShedError) as ei:
+            await b.result()
+        assert ei.value.cause == "shutdown"
+        with pytest.raises(ShedError):
+            sched.submit([6], 2)                  # post-stop submit sheds
+        return eng
+
+    eng = run_async(main())
+    assert eng.n_live == 0
+
+
+# ----------------------------------------------------------------------
+# stream parity with the offline engine
+# ----------------------------------------------------------------------
+
+def test_streams_match_offline_engine_greedy(mv):
+    """Concurrent scheduler streams are bit-identical to the offline
+    DecodeEngine run with the same per-request budgets (greedy)."""
+    prompts = [[1, 2, 3], [5, 6, 7, 8, 9, 10, 11], [20] * 17, [42, 43],
+               [9], [60, 61, 62, 63], [30] * 12, [2, 4, 6]]
+    budgets = [2, 6, 3, 5, 4, 2, 6, 3]
+
+    async def main():
+        eng = make_engine(mv, n_slots=2)
+        sched = Scheduler(eng, max_queue=16)
+        await sched.start()
+        handles = [sched.submit(p, b) for p, b in zip(prompts, budgets)]
+        await asyncio.gather(*(h.result() for h in handles))
+        await sched.stop()
+        return sched, handles
+
+    sched, handles = run_async(main())
+    ref_eng = make_engine(mv, n_slots=2)
+    refs = ref_eng.run(prompts, budgets)
+    for p, b, h, ref in zip(prompts, budgets, handles, refs):
+        assert h.retired.tokens == ref, f"stream diverged for prompt {p}"
+        assert h.tokens == ref[len(p):]           # streamed = generated
+        assert h.retired.reason == "budget"
+        assert len(h.tokens) == b
+    m = sched.metrics
+    assert m.counters["admitted"] == len(prompts)
+    assert m.ttft.count == len(prompts)
+    assert m.itl.count > 0
+    assert m.e2e.count == len(prompts)
+    assert m.mean_occupancy > 0.5                 # 8 reqs through 2 slots

@@ -1,0 +1,282 @@
+"""Streaming HTTP front-end (serve/server.py) e2e on localhost: 32
+concurrent SSE streams with mixed prompt lengths and mid-stream client
+disconnects, bit-identical to offline DecodeEngine greedy decoding;
+/metrics exposes non-empty TTFT/ITL histograms; queue-full maps to 429.
+
+Every async body runs under a hard `asyncio.wait_for` so a hung stream
+fails fast here AND in the dedicated CI step (tier1.yml runs this file
+under `timeout`)."""
+
+import asyncio
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_pytorch_tpu.config import LLMConfig
+from distributed_pytorch_tpu.engine import DecodeEngine
+from distributed_pytorch_tpu.models.gpt import LLM
+from distributed_pytorch_tpu.serve.scheduler import Scheduler
+from distributed_pytorch_tpu.serve.server import ServeApp
+
+
+def tiny_cfg(**kw):
+    base = dict(vocab_size=97, block_size=64, n_embd=48, n_head=4,
+                n_kv_heads=2, attn="gqa", n_layer=2, up_dim=64,
+                non_linearity="swiglu", pos_emb="rope", dropout=0.0)
+    base.update(kw)
+    return LLMConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def mv():
+    cfg = tiny_cfg()
+    model = LLM(cfg, attn_impl="naive")
+    rng = jax.random.PRNGKey(0)
+    x = jnp.zeros((1, cfg.block_size), jnp.int32)
+    variables = dict(model.init({"params": rng, "dropout": rng}, x, x))
+    return cfg, model, variables
+
+
+def run_async(coro, timeout=300):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+# ----------------------------------------------------------------------
+# minimal stdlib HTTP/SSE client
+# ----------------------------------------------------------------------
+
+async def http_get(port, path):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {path} HTTP/1.1\r\nHost: t\r\n\r\n".encode())
+    await writer.drain()
+    data = await reader.read()
+    writer.close()
+    head, _, body = data.partition(b"\r\n\r\n")
+    status = int(head.split(b" ")[1])
+    return status, body.decode()
+
+
+async def http_post(port, path, obj):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    body = json.dumps(obj).encode()
+    writer.write(f"POST {path} HTTP/1.1\r\nHost: t\r\n"
+                 f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+    await writer.drain()
+    return reader, writer
+
+
+async def sse_client(port, prompt, max_tokens, cancel_after=None):
+    """POST a streaming completion; return (tokens, done_event). With
+    `cancel_after`, hard-close the connection after that many tokens —
+    the mid-stream disconnect the server must turn into a cancel."""
+    reader, writer = await http_post(
+        port, "/v1/completions",
+        {"prompt": prompt, "max_tokens": max_tokens})
+    status_line = await reader.readline()
+    status = int(status_line.split(b" ")[1])
+    assert status == 200, status_line
+    while (await reader.readline()).strip():      # drain headers
+        pass
+    tokens, done = [], None
+    while True:
+        line = (await reader.readline()).decode().strip()
+        if not line:
+            continue
+        assert line.startswith("data: ")
+        payload = line[len("data: "):]
+        if payload == "[DONE]":
+            break
+        ev = json.loads(payload)
+        if "token" in ev:
+            tokens.append(ev["token"])
+            if cancel_after is not None and len(tokens) >= cancel_after:
+                writer.close()                    # mid-stream disconnect
+                return tokens, {"cancelled_by_client": True}
+        elif "done" in ev:
+            done = ev
+        elif "error" in ev:
+            done = ev
+            break
+    writer.close()
+    return tokens, done
+
+
+# ----------------------------------------------------------------------
+
+N_REQ = 32
+CANCEL_EVERY = 5      # requests 0, 5, 10, ... disconnect mid-stream
+CANCEL_AFTER = 2
+
+
+def _workload(vocab):
+    """Seeded mixed-length workload; cancel targets get budgets too large
+    to finish before the disconnect lands, so cancellation is
+    deterministic."""
+    rng = np.random.default_rng(0)
+    prompts = [list(map(int, rng.integers(1, vocab,
+                                          int(rng.integers(1, 21)))))
+               for _ in range(N_REQ)]
+    budgets = [int(rng.integers(2, 9)) for _ in range(N_REQ)]
+    cancels = set(range(0, N_REQ, CANCEL_EVERY))
+    for i in cancels:
+        budgets[i] = 30
+    return prompts, budgets, cancels
+
+
+def test_http_e2e_32_streams_parity_cancel_metrics(mv):
+    cfg, model, variables = mv
+    prompts, budgets, cancels = _workload(cfg.vocab_size)
+
+    async def main():
+        eng = DecodeEngine(model, variables, n_slots=4, temperature=0.0,
+                           min_bucket=8)
+        sched = Scheduler(eng, max_queue=64)
+        app = ServeApp(sched, port=0)
+        await sched.start()
+        await app.start()
+
+        results = await asyncio.gather(*(
+            sse_client(app.port, p, b,
+                       cancel_after=CANCEL_AFTER if i in cancels else None)
+            for i, (p, b) in enumerate(zip(prompts, budgets))))
+
+        # disconnect-driven cancels land asynchronously; drain them
+        deadline = asyncio.get_running_loop().time() + 60
+        while eng.n_live and asyncio.get_running_loop().time() < deadline:
+            await asyncio.sleep(0.05)
+        health = await http_get(app.port, "/healthz")
+        metrics = await http_get(app.port, "/metrics")
+        await app.stop()
+        await sched.stop()
+        return eng, sched, results, health, metrics
+
+    eng, sched, results, (h_status, h_body), (m_status, m_body) = \
+        run_async(main())
+
+    # --- bit-identical to the offline engine (greedy), same budgets ---
+    ref_eng = DecodeEngine(model, variables, n_slots=4, temperature=0.0,
+                           min_bucket=8)
+    refs = ref_eng.run(prompts, budgets)
+    for i, ((tokens, done), p, ref) in enumerate(zip(results, prompts,
+                                                     refs)):
+        gen_ref = ref[len(p):]
+        if i in cancels:
+            assert tokens == gen_ref[:CANCEL_AFTER], \
+                f"cancelled stream {i} diverged before the disconnect"
+        else:
+            assert tokens == gen_ref, f"stream {i} diverged from offline"
+            assert done["done"] and done["reason"] == "budget"
+
+    # --- cancellation freed every disconnected slot ---
+    assert eng.n_live == 0
+    assert eng.retire_counts["cancelled"] == len(cancels)
+    assert sched.metrics.counters["cancelled"] == len(cancels)
+
+    # --- health + metrics surface ---
+    assert h_status == 200 and json.loads(h_body)["ok"]
+    assert json.loads(h_body)["live_slots"] == 0
+    assert m_status == 200
+    lines = dict(
+        l.rsplit(" ", 1) for l in m_body.splitlines()
+        if l and not l.startswith("#"))
+    assert float(lines["serve_ttft_seconds_count"]) == N_REQ
+    assert float(lines["serve_itl_seconds_count"]) > 0
+    assert float(lines['serve_requests_total{event="admitted"}']) == N_REQ
+    assert float(lines['serve_requests_total{event="shed"}']) == 0
+    # zero starvation: every request reached a slot, worst queue wait
+    # bounded well inside the test budget
+    assert sched.metrics.queue_wait.count == N_REQ
+    assert sched.metrics.queue_wait.max < 120
+
+
+def test_http_queue_full_is_429(mv):
+    _, model, variables = mv
+
+    async def main():
+        eng = DecodeEngine(model, variables, n_slots=1, temperature=0.0,
+                           min_bucket=8)
+        sched = Scheduler(eng, max_queue=1)
+        app = ServeApp(sched, port=0)
+        await sched.start()
+        await app.start()
+
+        # stream A occupies the slot; read its first token so it is live
+        ra, wa = await http_post(app.port, "/v1/completions",
+                                 {"prompt": [1, 2, 3], "max_tokens": 40})
+        await ra.readline()                        # status
+        while (await ra.readline()).strip():       # headers
+            pass
+        await ra.readline()                        # first SSE event
+
+        # B fills the queue (fire and background-drain)
+        b_task = asyncio.ensure_future(
+            sse_client(app.port, [4, 5], 2))
+        while sched.queue_depth < 1:
+            await asyncio.sleep(0.01)
+
+        # C must be shed with an HTTP 429, immediately
+        rc, wc = await http_post(app.port, "/v1/completions",
+                                 {"prompt": [6], "max_tokens": 2})
+        status = int((await rc.readline()).split(b" ")[1])
+        body = (await rc.read()).split(b"\r\n\r\n")[-1]
+        wc.close()
+
+        wa.close()                                 # disconnect A -> cancel
+        await b_task
+        await app.stop()
+        await sched.stop()
+        return sched, status, json.loads(body)
+
+    sched, status, body = run_async(main())
+    assert status == 429
+    assert body["cause"] == "queue_full"
+    assert sched.metrics.shed_counts == {"queue_full": 1}
+
+
+def test_http_bad_requests(mv):
+    _, model, variables = mv
+
+    async def main():
+        eng = DecodeEngine(model, variables, n_slots=1, temperature=0.0,
+                           min_bucket=8)
+        sched = Scheduler(eng, max_queue=4)
+        app = ServeApp(sched, port=0, encoder=None)
+        await sched.start()
+        await app.start()
+        out = {}
+        out["nf"], _ = await http_get(app.port, "/nope")
+        r, w = await http_post(app.port, "/v1/completions",
+                               {"prompt": "text without a tokenizer"})
+        out["text"] = int((await r.readline()).split(b" ")[1])
+        w.close()
+        r, w = await http_post(app.port, "/v1/completions",
+                               {"prompt": []})
+        out["empty"] = int((await r.readline()).split(b" ")[1])
+        w.close()
+        r, w = await http_post(app.port, "/v1/completions",
+                               {"prompt": [1], "max_tokens": 0})
+        out["zero"] = int((await r.readline()).split(b" ")[1])
+        w.close()
+        # non-streaming mode still works
+        r, w = await http_post(app.port, "/v1/completions",
+                               {"prompt": [1, 2], "max_tokens": 3,
+                                "stream": False})
+        status = int((await r.readline()).split(b" ")[1])
+        data = await r.read()
+        w.close()
+        out["json"] = (status, json.loads(data.split(b"\r\n\r\n")[-1]))
+        await app.stop()
+        await sched.stop()
+        return out
+
+    out = run_async(main())
+    assert out["nf"] == 404
+    assert out["text"] == 400
+    assert out["empty"] == 400
+    assert out["zero"] == 400
+    status, body = out["json"]
+    assert status == 200
+    assert body["reason"] == "budget" and len(body["tokens"]) == 3

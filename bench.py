@@ -236,7 +236,15 @@ def _serve_bench(platform: str) -> dict:
     the probed steady service rate, so the queue genuinely fills: the leg
     reports the latency SLO quantiles (TTFT/ITL p50/p99), delivered
     tok/s/chip, shed rate at the admission bound, and mean slot occupancy
-    — the occupancy-vs-shed tradeoff the ROADMAP's serve A/B reads."""
+    — the occupancy-vs-shed tradeoff the ROADMAP's serve A/B reads.
+
+    BENCH_SERVE_PREFIX=0.8 turns it into the serve_load_prefix leg: that
+    fraction of requests share a fixed multi-block system prompt, the
+    block pool is sized TIGHT (~80% of slot-cache-equivalent, so
+    block-level preemption genuinely fires and must requeue, not lose),
+    and the SAME traffic runs twice — prefix cache on vs off — so the
+    line reports the prefix-cache hit rate, prefilled-tokens-per-request
+    reduction, and the TTFT collapse vs the no-reuse baseline."""
     import asyncio
     import time
 
@@ -254,6 +262,7 @@ def _serve_bench(platform: str) -> dict:
         cfg = flagship_gpt124m()
         S = int(os.environ.get("BENCH_DECODE_LEN", "1024"))
         slots = int(os.environ.get("BENCH_DECODE_SLOTS", "32"))
+        kv_block = int(os.environ.get("BENCH_KV_BLOCK", "128"))
         dtype = jnp.bfloat16
         n_req, p_lo, p_hi, b_lo, b_hi = 192, 64, 512, 16, 96
         preset = "gpt2_124m"
@@ -262,6 +271,7 @@ def _serve_bench(platform: str) -> dict:
                         n_head=4, n_kv_heads=4, attn="mha", n_layer=2,
                         up_dim=256, non_linearity="swiglu", pos_emb="rope")
         S, slots, dtype = 128, 4, jnp.float32
+        kv_block = int(os.environ.get("BENCH_KV_BLOCK", "16"))
         n_req, p_lo, p_hi, b_lo, b_hi = 32, 4, 48, 4, 12
         preset = "cpu_tiny"
     model = LLM(cfg, compute_dtype=dtype, attn_impl="auto")
@@ -271,26 +281,58 @@ def _serve_bench(platform: str) -> dict:
                                     dummy, dummy)
     cache_dtype = os.environ.get("BENCH_CACHE_DTYPE", "") or None
     quant_w = os.environ.get("BENCH_QUANT_W", "") == "1"
-    eng = DecodeEngine(model, variables, n_slots=slots, max_len=S,
-                       temperature=1.0, top_k=50,
-                       cache_dtype=cache_dtype, quantize_weights=quant_w)
+    prefix_frac = float(os.environ.get("BENCH_SERVE_PREFIX", "0") or 0)
+    # prefix leg: size the pool TIGHT (prefix sharing reclaims most of
+    # it) so block-level preemption actually exercises the requeue path;
+    # plain leg keeps the slot-cache-equivalent default
+    n_blocks = (int(slots * (S // kv_block) * 0.7) + 1
+                if prefix_frac > 0 else None)
+
+    def make_engine(prefix_cache: bool) -> "DecodeEngine":
+        return DecodeEngine(model, variables, n_slots=slots, max_len=S,
+                            temperature=1.0, top_k=50,
+                            cache_dtype=cache_dtype,
+                            quantize_weights=quant_w, block_size=kv_block,
+                            n_blocks=n_blocks, prefix_cache=prefix_cache)
 
     npr = np.random.default_rng(0)
-    reqs = [(list(npr.integers(0, cfg.vocab_size,
-                               int(npr.integers(p_lo, p_hi)))),
-             int(npr.integers(b_lo, b_hi)))
-            for _ in range(n_req)]
+    if prefix_frac > 0:
+        # 80%-shared traffic: a fixed system prompt of several full
+        # blocks plus a short per-request tail; the rest fully random
+        sys_prompt = list(npr.integers(0, cfg.vocab_size, 5 * kv_block))
+        reqs = []
+        for _ in range(n_req):
+            if npr.random() < prefix_frac:
+                tail = list(npr.integers(
+                    0, cfg.vocab_size,
+                    int(npr.integers(1, kv_block // 2 + 2))))
+                prompt = sys_prompt + tail
+            else:
+                prompt = list(npr.integers(0, cfg.vocab_size,
+                                           int(npr.integers(p_lo, p_hi))))
+            reqs.append((prompt, int(npr.integers(b_lo, b_hi))))
+    else:
+        reqs = [(list(npr.integers(0, cfg.vocab_size,
+                                   int(npr.integers(p_lo, p_hi)))),
+                 int(npr.integers(b_lo, b_hi)))
+                for _ in range(n_req)]
 
-    # warm every prefill bucket + the fused step OUTSIDE the timed window
-    # (a 1-token budget retires at admission, freeing the slot instantly)
-    for bucket in sorted({eng.prefill_bucket(len(p)) for p, _ in reqs}):
-        eng.admit(list(npr.integers(0, cfg.vocab_size, bucket)), 1)
-    eng.admit(reqs[0][0], 2)
-    eng.step()
+    eng = make_engine(prefix_cache=True)
+
+    def warm(e):
+        # warm every prefill bucket + the fused step OUTSIDE the timed
+        # window (a 1-token budget retires at admission instantly)
+        for bucket in sorted({e.prefill_bucket(len(p)) for p, _ in reqs}):
+            e.admit(list(npr.integers(0, cfg.vocab_size, bucket)), 1)
+        e.admit(reqs[0][0], 2)
+        e.step()
+
+    warm(eng)
 
     # probe the steady step time at full occupancy -> offered arrival rate
     while eng.free_slots:
-        eng.admit(list(npr.integers(0, cfg.vocab_size, p_hi - 1)), 10 ** 9)
+        eng.admit(list(npr.integers(0, cfg.vocab_size,
+                                    min(p_hi, S // 2) - 1)), 10 ** 9)
     eng.step()
     t0 = time.perf_counter()
     probe_steps = 8
@@ -309,49 +351,93 @@ def _serve_bench(platform: str) -> dict:
     gaps = npr.exponential(1.0 / req_rate, size=n_req)
     arrivals = np.cumsum(gaps)
 
-    async def drive():
-        sched = Scheduler(eng, max_queue=2 * slots)
-        await sched.start()
-        consumers, shed = [], 0
-        start = time.perf_counter()
-        for (prompt, budget), at in zip(reqs, arrivals):
-            delay = start + at - time.perf_counter()
-            if delay > 0:
-                await asyncio.sleep(delay)
-            try:
-                h = sched.submit(prompt, budget)
-            except ShedError:
-                shed += 1
-                continue
-            consumers.append(asyncio.ensure_future(h.result()))
-        await asyncio.gather(*consumers, return_exceptions=True)
-        dt = time.perf_counter() - start
-        await sched.stop()
-        return sched, shed, dt
+    def drive(e):
+        async def _run():
+            sched = Scheduler(e, max_queue=4 * slots)
+            await sched.start()
+            consumers, shed = [], 0
+            start = time.perf_counter()
+            for (prompt, budget), at in zip(reqs, arrivals):
+                delay = start + at - time.perf_counter()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                try:
+                    h = sched.submit(prompt, budget)
+                except ShedError:
+                    shed += 1
+                    continue
+                consumers.append(asyncio.ensure_future(h.result()))
+            await asyncio.gather(*consumers, return_exceptions=True)
+            dt = time.perf_counter() - start
+            await sched.stop()
+            return sched, shed, dt
 
-    sched, shed, dt = asyncio.run(drive())
+        return asyncio.run(_run())
+
+    # snapshot prefix counters so warm/probe admissions don't pollute the
+    # timed window's hit-rate / prefilled-per-request accounting
+    pre = (eng.prompt_tokens, eng.prefix_hit_tokens, eng.prefilled_tokens)
+    sched, shed, dt = drive(eng)
+    d_prompt = eng.prompt_tokens - pre[0]
+    d_hit = eng.prefix_hit_tokens - pre[1]
+    d_prefilled = eng.prefilled_tokens - pre[2]
     s = sched.metrics.summary()
     toks = sched.metrics.counters["tokens_out"]
-    return {"metric": ("serve_tokens_per_sec_per_chip" if platform == "tpu"
-                       else "cpu_proxy_serve_tokens_per_sec_per_chip"),
-            "value": round(toks / dt / n_dev, 1), "unit": "tok/s/chip",
-            "vs_baseline": 0,
-            "ttft_p50_ms": s["ttft"].get("p50_ms"),
-            "ttft_p99_ms": s["ttft"].get("p99_ms"),
-            "itl_p50_ms": s["itl"].get("p50_ms"),
-            "itl_p99_ms": s["itl"].get("p99_ms"),
-            "e2e_p50_ms": s["e2e"].get("p50_ms"),
-            "queue_wait_p99_ms": s["queue_wait"].get("p99_ms"),
-            "shed_rate": round(shed / n_req, 3),
-            "mean_occupancy": s["mean_occupancy"],
-            "probe_step_ms": round(step_s * 1e3, 2),
-            "offered_rps": round(req_rate, 2), "load_factor": load_factor,
-            "n_requests": n_req, "n_slots": slots, "cache_len": S,
-            "flash_decode": os.environ.get("FLASH_DECODE", "auto"),
-            "cache_dtype": jnp.dtype(eng.cache_dtype).name,
-            "quant_w": eng.weights_quantized,
-            "n_chips": n_dev, "device": jax.devices()[0].device_kind,
-            "preset": preset}
+    admitted = max(sched.metrics.counters["admitted"]
+                   - sched.metrics.counters["requeued"], 1)
+    out = {"metric": ("serve_tokens_per_sec_per_chip" if platform == "tpu"
+                      else "cpu_proxy_serve_tokens_per_sec_per_chip"),
+           "value": round(toks / dt / n_dev, 1), "unit": "tok/s/chip",
+           "vs_baseline": 0,
+           "ttft_p50_ms": s["ttft"].get("p50_ms"),
+           "ttft_p99_ms": s["ttft"].get("p99_ms"),
+           "itl_p50_ms": s["itl"].get("p50_ms"),
+           "itl_p99_ms": s["itl"].get("p99_ms"),
+           "e2e_p50_ms": s["e2e"].get("p50_ms"),
+           "queue_wait_p99_ms": s["queue_wait"].get("p99_ms"),
+           "shed_rate": round(shed / n_req, 3),
+           "mean_occupancy": s["mean_occupancy"],
+           "probe_step_ms": round(step_s * 1e3, 2),
+           "offered_rps": round(req_rate, 2), "load_factor": load_factor,
+           "n_requests": n_req, "n_slots": slots, "cache_len": S,
+           "kv_block": kv_block, "n_kv_blocks": eng.n_blocks,
+           "block_utilization": round(eng.block_utilization, 4),
+           "flash_decode": os.environ.get("FLASH_DECODE", "auto"),
+           "cache_dtype": jnp.dtype(eng.cache_dtype).name,
+           "quant_w": eng.weights_quantized,
+           "n_chips": n_dev, "device": jax.devices()[0].device_kind,
+           "preset": preset}
+    if prefix_frac > 0:
+        # the no-reuse baseline: SAME traffic, fresh engine with the
+        # prefix cache off — the pair the acceptance criteria compare
+        base_eng = make_engine(prefix_cache=False)
+        warm(base_eng)
+        base_pre = base_eng.prefilled_tokens
+        base_sched, base_shed, base_dt = drive(base_eng)
+        bs_ = base_sched.metrics.summary()
+        lost = (n_req - shed - sched.metrics.counters["completed"])
+        out.update({
+            "prefix_frac": prefix_frac,
+            "prefix_hit_rate": round(d_hit / max(d_prompt, 1), 4),
+            "prefilled_per_request": round(d_prefilled / admitted, 1),
+            "prefilled_per_request_baseline": round(
+                (base_eng.prefilled_tokens - base_pre)
+                / max(base_sched.metrics.counters["admitted"]
+                      - base_sched.metrics.counters["requeued"], 1), 1),
+            "preempted": sched.metrics.counters["preempted"],
+            "requeued": sched.metrics.counters["requeued"],
+            "lost_to_preemption": lost,
+            "baseline_ttft_p50_ms": bs_["ttft"].get("p50_ms"),
+            "baseline_ttft_p99_ms": bs_["ttft"].get("p99_ms"),
+            "baseline_shed_rate": round(base_shed / n_req, 3),
+            "baseline_tokens_per_sec_per_chip": round(
+                base_sched.metrics.counters["tokens_out"]
+                / base_dt / n_dev, 1),
+        })
+        ppr, base_ppr = (out["prefilled_per_request"],
+                         out["prefilled_per_request_baseline"])
+        out["prefill_reduction_x"] = round(base_ppr / max(ppr, 1e-9), 2)
+    return out
 
 
 def run_bench(platform: str, only_recipe: str | None = None) -> dict:
@@ -668,7 +754,14 @@ def main() -> None:
                     ("serve_load_int8", {"BENCH_SERVE": "1",
                                          "FLASH_DECODE": "on",
                                          "BENCH_CACHE_DTYPE": "int8",
-                                         "BENCH_QUANT_W": "1"})]:
+                                         "BENCH_QUANT_W": "1"}),
+                    # PR 6: paged cache + radix prefix reuse — 80%
+                    # shared-prefix Poisson traffic vs the no-reuse
+                    # baseline (TTFT collapse, hit rate, prefilled/req,
+                    # preemption-requeue accounting)
+                    ("serve_load_prefix", {"BENCH_SERVE": "1",
+                                           "FLASH_DECODE": "on",
+                                           "BENCH_SERVE_PREFIX": "0.8"})]:
                 r = _spawn_worker("tpu", timeout_s=900, extra_env=env)
                 if r:
                     decode_results[name] = r

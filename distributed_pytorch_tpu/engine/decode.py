@@ -1,42 +1,50 @@
-"""Continuous-batching decode engine over a slot-based KV cache.
+"""Continuous-batching decode engine over a PAGED KV cache with radix
+prefix reuse.
 
 The serving-shaped inference path the ROADMAP's "heavy traffic from
-millions of users" north star needs, built on the round-8 per-sequence
-position machinery (models/attention.py `_update_cache`, models/gpt.py
-`pos`/`logits_idx`):
+millions of users" north star needs. Round 8 built this engine on a fixed
+(n_slots, S) slot cache; this round replaces the slot cache with a
+vLLM-style paged cache (ops/block_pool.py) because the slot cache paid
+for the worst case twice — S rows of HBM per slot regardless of the
+actual sequence length, and a full prefill per request even when
+thousands of requests share a system prompt:
 
-* **Fixed slot cache**: ONE (B_slots, S, ...) buffer set per layer lives
-  for the engine's lifetime. A sequence occupies a slot from admission to
-  retirement; rows past its per-slot position are causally masked, so a
-  retired slot needs no cleanup — the next occupant's prefill and decode
-  writes overwrite exactly the rows they validate.
-* **Bucketed prefill**: prompts are right-padded to the next power of two
-  (>= `min_bucket`), so repeated admissions compile once per bucket, not
-  once per exact prompt length. The prefill reads logits at the true last
-  row (`logits_idx`) — pad rows never influence sampled tokens — and the
-  filled (1, bucket, ...) cache is spliced into the slot row with one
-  dynamic-slice write per layer.
-* **One fused decode step**: every live slot advances one token in a
-  single jitted call — tokens (B_slots,), per-slot positions (B_slots,),
-  shared cache. Dead slots ride along (their position is frozen and their
-  sampled token discarded): batching the ragged set beats per-sequence
-  dispatch because decode is memory-bound on the weights, which are read
-  once for the whole batch. The step function is traced exactly once
-  regardless of admission/retirement order (`step_traces` asserts this in
-  tests).
-* **Mesh-aware**: with `mesh` + `recipe`, params are placed by the
-  training recipe's PartitionSpec tables (parallel/sharding.py — the same
-  layout `sample.py --shard` restores into) and cache buffers shard kv
-  heads over 'model' and slots over 'data'
-  (`sharding.decode_cache_pspec`), so a ladder checkpoint decodes on a
-  mesh instead of replicated. The flash-decode kernel declines under a
-  live multi-device mesh (GSPMD cannot partition a pallas_call) and the
-  naive path carries the sharded step.
+* **Paged pool + block tables**: ONE (n_blocks, block_size, ...) pool set
+  per layer lives for the engine's lifetime; each live sequence owns an
+  ordered list of blocks recorded in a per-sequence row of the
+  (n_slots, max_blocks) block table. Cache writes indirect through the
+  table (`paged_update`); the flash-decode kernel prefetches the table
+  row and DMAs blocks straight from the pool; non-kernel paths read a
+  gathered logical view — bit-compatible with the old contiguous cache.
+  Retired slots' table rows are zeroed so the fused step's dead-slot
+  write lands in the reserved null block, never in a reallocated one.
+* **Radix prefix reuse**: full prompt blocks are content-addressed by
+  chain key (block_pool docstring); at admission the longest cached
+  block-chain prefix is SHARED (refcounted, immutable — copy-on-write at
+  block granularity: the partial tail is always private), and only the
+  suffix is prefilled, into its pow2 bucket. A shared system prompt
+  prefills once; followers admit with a near-empty prefill — at high
+  shared-prefix traffic this beats any kernel win (PERF.md). Retiring
+  sequences publish their full blocks into the refcount-0 LRU, so hot
+  prefixes stay resident in HBM that would otherwise idle.
+* **Block-level preemption, not shedding**: when a live sequence needs a
+  block and the pool is exhausted (every block referenced), the
+  youngest-admitted live sequence is retired with reason 'preempted'
+  carrying its tokens so far — callers (engine.run, serve/scheduler.py)
+  REQUEUE it; its published blocks make the re-prefill a prefix-cache
+  hit. 'cache_full' now only means a single sequence hit `max_len`;
+  admission-side exhaustion raises `NoFreeBlocks` (the request stays
+  queued — shed remains reserved for admission-bound overflow).
+* **Bucketed prefill / one fused step / mesh-awareness** are unchanged
+  from round 8: suffixes are right-padded to pow2 buckets (one compiled
+  prefill per bucket — prefix length is traced, so reuse does not add
+  traces), every live slot advances in a single jitted step traced once,
+  and under a mesh the pools shard kv heads over 'model' and blocks over
+  'data' via `sharding.decode_cache_pspec`.
 
-Host/device split: sampling, cache updates, and position bookkeeping are
-device-side; the host loop only reads each step's sampled tokens to
-decide retirement (EOS / max_new_tokens / cache full) and feed admissions
-— the minimal per-step sync a streaming server needs anyway.
+Host/device split as before: sampling, cache writes, and positions are
+device-side; the allocator, radix index, and retirement logic are plain
+Python on the host thread that owns the engine.
 """
 
 from __future__ import annotations
@@ -51,18 +59,23 @@ import jax.numpy as jnp
 import numpy as np
 
 from distributed_pytorch_tpu.models.generate import sample_token
-from distributed_pytorch_tpu.models.gpt import init_cache
+from distributed_pytorch_tpu.models.gpt import init_paged_cache
+from distributed_pytorch_tpu.ops.block_pool import (BlockPool, NoFreeBlocks,
+                                                    chain_keys)
 from distributed_pytorch_tpu.parallel import context
 
 
 #: Why a sequence left its slot — the serving layer routes on these.
-RETIRE_REASONS = ("eos", "budget", "cache_full", "cancelled")
+#: 'preempted' carries partial output that callers REQUEUE, never drop.
+RETIRE_REASONS = ("eos", "budget", "cache_full", "cancelled", "preempted")
 
 
 @dataclasses.dataclass
 class Retired:
     """A finished sequence: its tokens (prompt + generated) and why it
-    stopped — 'eos' | 'budget' | 'cache_full' | 'cancelled'."""
+    stopped — 'eos' | 'budget' | 'cache_full' | 'cancelled' |
+    'preempted' (the pool needed its blocks; resubmit `tokens` with the
+    remaining budget to resume from the retained prefix blocks)."""
 
     tokens: list
     reason: str
@@ -72,20 +85,24 @@ class Retired:
 @dataclasses.dataclass
 class Admission:
     """What `admit()` hands back: the sequence id, the first sampled token
-    (prefill samples it — a streaming caller's TTFT token), and, for a
-    request that finished AT prefill (1-token budget, instant EOS), its
-    `Retired` record — such a request never appears in a later `step()`."""
+    (prefill samples it — a streaming caller's TTFT token), prefix-cache
+    accounting (`prefix_len` reused tokens, `prefilled` suffix tokens
+    actually computed), and, for a request that finished AT prefill
+    (1-token budget, instant EOS), its `Retired` record."""
 
     seq_id: int
     first_token: int
     retired: Optional[Retired] = None
+    prefix_len: int = 0
+    prefilled: int = 0
 
 
 @dataclasses.dataclass
 class StepResult:
     """One fused step's host-visible output: `emitted` maps every sequence
-    that was live this step to the token it sampled (including sequences
-    retiring on that token); `retired` holds the subset that finished."""
+    that advanced this step to the token it sampled; `retired` holds the
+    sequences that finished — including any preempted BEFORE the step ran
+    (those emit no token)."""
 
     emitted: dict
     retired: dict
@@ -93,7 +110,7 @@ class StepResult:
 
 @dataclasses.dataclass
 class _Slot:
-    """Host-side bookkeeping for one occupied cache slot."""
+    """Host-side bookkeeping for one occupied table row."""
 
     seq_id: int
     tokens: list          # prompt + generated so far
@@ -101,28 +118,34 @@ class _Slot:
     n_new: int            # generated tokens recorded so far
     max_new: int
     pos: int              # device pos mirror: next cache write position
+    blocks: list          # owned physical block ids, logical order
+    order: int            # admission counter (preemption picks the max)
 
 
 class DecodeEngine:
-    """Continuous batching: admit prompts into free slots, step all live
-    slots in one fused jitted call, retire finished sequences.
+    """Continuous batching over the paged KV cache: admit prompts (sharing
+    any cached prefix), step all live slots in one fused jitted call,
+    retire finished sequences, preempt-and-requeue when the pool runs dry.
 
     >>> eng = DecodeEngine(model, variables, n_slots=8, temperature=0.0)
     >>> outs = eng.run(prompts, max_new_tokens=64)   # list of token lists
 
-    Quantized serving (ops/quant.py): `cache_dtype='int8'` quantizes the
-    KV cache on the ring write (flash-decode dequantizes in VMEM),
-    `quantize_weights=True` runs the decode matmuls on int8 codes +
-    per-output-channel scales while prefill keeps bf16 — together ~1.9x
-    fewer bytes per step at the bench decode shape (PERF.md round 9).
+    Paging knobs: `block_size` (KV rows per block, pow2; default 16 capped
+    at `min_bucket` so the pow2 buckets stay block-aligned — serving on
+    TPU wants 128+ so the paged kernel's DMA tiles are worth it),
+    `n_blocks` (pool size; default sized to the old slot cache's
+    n_slots x max_len footprint, i.e. never preempts under slot-cache
+    load; smaller pools trade preemption for HBM), `prefix_cache=False`
+    disables content-addressed reuse (the A/B baseline).
 
-    or stream it yourself: `admit()` (returns an `Admission` with the
-    first sampled token) until `free_slots` is empty, then `step()`
-    repeatedly — each `StepResult` carries every live sequence's new token
-    plus `Retired` records (tokens + reason: eos | budget | cache_full)
-    for the ones that finished. `cancel(seq_id)` frees a slot mid-decode;
-    `n_free`/`occupancy`/`retire_counts` are the stable accounting surface
-    the serve/ scheduler reads (never the private `_slots`).
+    Quantized serving (ops/quant.py) is unchanged: `cache_dtype='int8'`
+    quantizes on the block write (scale sidecars ride pool-shaped
+    buffers), `quantize_weights=True` runs decode matmuls on int8 codes.
+
+    The stable accounting surface a scheduler reads: `n_free`/`occupancy`
+    /`retire_counts` plus the paged additions `block_utilization`/
+    `block_fragmentation`/`prefix_hit_rate`/`prefilled_tokens` (never the
+    private `_slots`).
     """
 
     def __init__(self, model, variables: dict, *, n_slots: int = 8,
@@ -130,22 +153,17 @@ class DecodeEngine:
                  quantize_weights: bool = False,
                  temperature: float = 0.0, top_k: Optional[int] = None,
                  eos_id: Optional[int] = None, rng=None,
-                 mesh=None, recipe: str = "single", min_bucket: int = 16):
+                 mesh=None, recipe: str = "single", min_bucket: int = 16,
+                 block_size: Optional[int] = None,
+                 n_blocks: Optional[int] = None,
+                 prefix_cache: bool = True):
         cfg = model.config
         self.model = model
         self.cfg = cfg
         self.n_slots = n_slots
         self.max_len = max_len or cfg.block_size
         assert self.max_len <= cfg.block_size
-        # Quantized serving knobs (ops/quant.py). cache_dtype='int8' (or
-        # jnp.int8) quantizes the KV cache on the ring write — int8 codes
-        # + f32 scale sidecars ride the cache pytree, the flash-decode
-        # kernel dequantizes in VMEM. quantize_weights=True quantizes the
-        # params once here; decode matmuls read int8 codes with the scale
-        # applied on the output, PREFILL keeps the bf16 originals. The
-        # QUANT_KV / QUANT_W env gates (auto|on|off) override both for
-        # bench/sweep A/B legs; `quant_kv_usable` degrades MLA to the
-        # compute dtype instead of crashing.
+        # Quantized serving knobs (ops/quant.py) — see class docstring.
         from distributed_pytorch_tpu.ops import quant
         if cache_dtype is not None and not isinstance(cache_dtype, str):
             cache_dtype = jnp.dtype(cache_dtype).name
@@ -167,6 +185,27 @@ class DecodeEngine:
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         self._mesh = mesh
         self._recipe = recipe
+
+        # paged-cache geometry: pow2 blocks no larger than the smallest
+        # prefill bucket, so every bucket is a whole number of blocks
+        bs = block_size or min(16, min_bucket)
+        assert bs > 0 and bs & (bs - 1) == 0, \
+            f"block_size must be a power of two, got {bs}"
+        assert self.max_len % bs == 0, \
+            f"max_len {self.max_len} not a multiple of block_size {bs}"
+        self.block_size = bs
+        self.max_blocks = self.max_len // bs
+        if n_blocks is None:
+            # slot-cache-equivalent footprint (+ null block), rounded up
+            # so the pool's block axis stays 'data'-shardable on a mesh
+            n_blocks = n_slots * self.max_blocks + 1
+            n_blocks += (-n_blocks) % 8
+        assert n_blocks > self.max_blocks, (
+            f"pool of {n_blocks} blocks cannot hold one max_len sequence "
+            f"({self.max_blocks} blocks) plus the null block")
+        self.n_blocks = n_blocks
+        self.block_pool = BlockPool(n_blocks, bs)
+        self.prefix_cache = prefix_cache
 
         if mesh is not None:
             from distributed_pytorch_tpu.parallel import sharding as shd
@@ -191,8 +230,7 @@ class DecodeEngine:
             with self._ctx():
                 self._qparams = jax.jit(quantize_params)(variables["params"])
 
-        caches = init_cache(cfg, n_slots, self.max_len,
-                            dtype=self.cache_dtype)
+        caches = init_paged_cache(cfg, n_blocks, bs, dtype=self.cache_dtype)
         if mesh is not None:
             from distributed_pytorch_tpu.parallel import sharding as shd
             from jax.sharding import NamedSharding
@@ -204,12 +242,18 @@ class DecodeEngine:
         self.tok = jnp.zeros((n_slots,), jnp.int32)
         self.pos = jnp.zeros((n_slots,), jnp.int32)
         self.live = jnp.zeros((n_slots,), bool)
+        # host-mirrored block tables: rows of physical block ids per slot;
+        # zeroed rows route dead-slot writes to the null block
+        self._tables_h = np.zeros((n_slots, self.max_blocks), np.int32)
+        self._tables_dirty = True
+        self.block_tables = None
+        self._sync_tables()
 
         self._slots: dict[int, _Slot] = {}     # slot index -> bookkeeping
         self._next_id = 0
         self._t = 0                            # global step counter (rng)
         self._n_admits = 0
-        # donation keeps the big cache in place on TPU; CPU jit warns on
+        # donation keeps the big pool in place on TPU; CPU jit warns on
         # unusable donations, so skip it there
         self._donate = (1,) if jax.default_backend() == "tpu" else ()
         self._step_fn = None
@@ -220,6 +264,10 @@ class DecodeEngine:
         # scheduler reads instead of poking _slots
         self.n_admitted = 0
         self.retire_counts = dict.fromkeys(RETIRE_REASONS, 0)
+        # prefix-cache accounting (bench + /metrics read these)
+        self.prompt_tokens = 0        # prompt tokens across admissions
+        self.prefix_hit_tokens = 0    # of those, served from cached blocks
+        self.prefilled_tokens = 0     # suffix tokens actually prefilled
 
     # ------------------------------------------------------------------
     # jitted device programs
@@ -233,11 +281,25 @@ class DecodeEngine:
         return sample_token(logits, rng, temperature=self.temperature,
                             top_k=self.top_k)
 
+    def _sync_tables(self) -> None:
+        """Push the host block tables to the device when they changed —
+        BEFORE any step/admit, so a retired slot's zeroed row is live by
+        the time the next dead-slot write could land."""
+        if not self._tables_dirty:
+            return
+        bt = jnp.asarray(self._tables_h)
+        if self._mesh is not None:
+            from distributed_pytorch_tpu.parallel import sharding as shd
+            from jax.sharding import NamedSharding
+            bt = jax.device_put(bt, NamedSharding(self._mesh, shd.P()))
+        self.block_tables = bt
+        self._tables_dirty = False
+
     def _get_step_fn(self):
         if self._step_fn is not None:
             return self._step_fn
 
-        def step(variables, caches, tok, pos, live, rng, t, qparams):
+        def step(variables, caches, tok, pos, live, bt, rng, t, qparams):
             self.step_traces += 1  # python side effect: counts traces only
             from distributed_pytorch_tpu.ops.quant import use_quantized_params
             with use_quantized_params(qparams):
@@ -246,10 +308,11 @@ class DecodeEngine:
                 # the unused bf16 leaves are pruned from the compiled step
                 logits, _, caches = self.model.apply(
                     variables, tok[:, None], None, caches, pos,
-                    deterministic=True)
+                    deterministic=True, block_tables=bt)
             nxt = self._sample(logits[:, -1, :], jax.random.fold_in(rng, t))
-            # dead slots: freeze the token and position (their cache row
-            # write lands on an already-masked slot; no cleanup needed)
+            # dead slots: freeze the token and position (their table row is
+            # zeroed, so the write lands in the null block — nothing reads
+            # it, no cleanup needed)
             nxt = jnp.where(live, nxt, tok)
             pos = pos + live.astype(jnp.int32)
             return caches, nxt, pos
@@ -262,23 +325,22 @@ class DecodeEngine:
         if fn is not None:
             return fn
 
-        def admit(variables, caches, tok, pos, live, prompt, true_len,
-                  slot, rng):
+        def admit(variables, caches, tok, pos, live, bt, prompt, prefix_len,
+                  true_len, slot, rng):
             self.admit_traces[bucket] = self.admit_traces.get(bucket, 0) + 1
-            small = init_cache(self.cfg, 1, bucket, dtype=self.cache_dtype)
-            logits, _, small = self.model.apply(
-                variables, prompt, None, small, 0, deterministic=True,
-                logits_idx=true_len - 1)
+            # suffix prefill straight into the slot's pool blocks: the
+            # reused prefix is already resident, so the forward starts at
+            # prefix_len (TRACED — any prefix length shares this bucket's
+            # compiled program) and attends the whole logical view
+            bt_row = jax.lax.dynamic_slice(
+                bt, (slot, jnp.int32(0)), (1, bt.shape[1]))
+            logits, _, caches = self.model.apply(
+                variables, prompt, None, caches, prefix_len,
+                deterministic=True, logits_idx=true_len - 1,
+                block_tables=bt_row)
             first = self._sample(logits[:, -1, :], rng)
-
-            def ins(big, sm):
-                zeros = (0,) * (big.ndim - 2)
-                return jax.lax.dynamic_update_slice(
-                    big, sm.astype(big.dtype), (slot, 0, *zeros))
-
-            caches = jax.tree_util.tree_map(ins, caches, small)
             tok = tok.at[slot].set(first[0])
-            pos = pos.at[slot].set(true_len[0])
+            pos = pos.at[slot].set(prefix_len + true_len[0])
             live = live.at[slot].set(True)
             return caches, tok, pos, live, first
 
@@ -304,8 +366,33 @@ class DecodeEngine:
 
     @property
     def occupancy(self) -> float:
-        """Live fraction of the slot cache, 0.0..1.0."""
+        """Live fraction of the slot table, 0.0..1.0."""
         return len(self._slots) / self.n_slots
+
+    @property
+    def block_utilization(self) -> float:
+        """Referenced fraction of the block pool (cached-but-unreferenced
+        prefix blocks are reclaimable and don't count)."""
+        return self.block_pool.utilization
+
+    @property
+    def block_fragmentation(self) -> float:
+        """Internal fragmentation of live blocks: the fraction of rows in
+        referenced blocks not (yet) holding a valid token — the paged
+        analogue of the slot cache's (S - len)/S waste, now bounded by
+        one partial block per sequence."""
+        live_blocks = sum(len(s.blocks) for s in self._slots.values())
+        if not live_blocks:
+            return 0.0
+        used = sum(min(s.pos, len(s.blocks) * self.block_size)
+                   for s in self._slots.values())
+        return 1.0 - used / (live_blocks * self.block_size)
+
+    @property
+    def prefix_hit_rate(self) -> float:
+        """Lifetime fraction of prompt tokens served from cached blocks."""
+        return (self.prefix_hit_tokens / self.prompt_tokens
+                if self.prompt_tokens else 0.0)
 
     @property
     def n_steps(self) -> int:
@@ -327,10 +414,11 @@ class DecodeEngine:
         raise KeyError(f"seq {seq_id} is not live")
 
     def prefill_bucket(self, prompt_len: int) -> int:
-        """The power-of-two bucket a prompt of this length prefills in —
-        admissions sharing a bucket share one compiled prefill trace, so a
-        scheduler can group same-bucket prompts back-to-back."""
-        b = self.min_bucket
+        """The pow2 bucket a (suffix of this length's) prefill runs in —
+        admissions sharing a bucket share one compiled prefill trace. The
+        floor is max(min_bucket, block_size) so buckets stay whole
+        blocks."""
+        b = max(self.min_bucket, self.block_size)
         while b < prompt_len:
             b *= 2
         return min(b, self.max_len)
@@ -341,20 +429,33 @@ class DecodeEngine:
             return "eos"
         if seq.n_new >= seq.max_new:
             return "budget"
-        if seq.pos >= self.max_len:  # next write would wrap the ring
+        if seq.pos >= self.max_len:  # table capacity: no next row exists
             return "cache_full"
         return None
 
     def _retire(self, slot: int, reason: str) -> Retired:
         seq = self._slots.pop(slot)
         self.retire_counts[reason] += 1
+        # publish the sequence's full blocks into the prefix cache before
+        # releasing: refcount-0 registered blocks land on the LRU, so a
+        # follow-up (or a preemption resume) re-admits with a prefix hit
+        if self.prefix_cache:
+            full = min(seq.pos, len(seq.blocks) * self.block_size) \
+                // self.block_size
+            for key, blk in zip(chain_keys(seq.tokens, self.block_size,
+                                           full), seq.blocks):
+                self.block_pool.register(blk, key)
+        self.block_pool.release_all(seq.blocks)
+        self._tables_h[slot, :] = 0
+        self._tables_dirty = True
         return Retired(tokens=seq.tokens, reason=reason,
                        prompt_len=seq.prompt_len)
 
     def cancel(self, seq_id: int) -> Optional[Retired]:
-        """Free a live sequence's slot immediately (client disconnect).
-        Returns its partial `Retired(reason='cancelled')`, or None when the
-        id is not live (already retired — the token stream won the race)."""
+        """Free a live sequence's slot and blocks immediately (client
+        disconnect). Returns its partial `Retired(reason='cancelled')`, or
+        None when the id is not live (already retired — the token stream
+        won the race)."""
         for slot, seq in self._slots.items():
             if seq.seq_id == seq_id:
                 ret = self._retire(slot, "cancelled")
@@ -362,12 +463,32 @@ class DecodeEngine:
                 return ret
         return None
 
+    def _match_prefix(self, toks: list) -> tuple[int, list]:
+        """Longest cached block-chain prefix of `toks`, capped so at least
+        one suffix token remains to prefill (the prefill must produce the
+        logits the first sampled token comes from). Returns
+        (prefix_len, matched block ids) WITHOUT taking refs."""
+        if not self.prefix_cache:
+            return 0, []
+        matched: list[int] = []
+        limit = (len(toks) - 1) // self.block_size
+        for key in chain_keys(toks, self.block_size, limit):
+            blk = self.block_pool.lookup(key)
+            if blk is None:
+                break
+            matched.append(blk)
+        return len(matched) * self.block_size, matched
+
     def admit(self, prompt, max_new_tokens: int,
               seq_id: Optional[int] = None) -> Admission:
-        """Prefill `prompt` (1D int sequence) into a free slot. Returns an
-        `Admission` (seq id + first sampled token + `retired` when the
-        request finished at prefill). Raises when no slot is free (check
-        `free_slots`)."""
+        """Prefill `prompt` (1D int sequence) into a free slot, reusing
+        any cached block-aligned prefix. Returns an `Admission` (seq id +
+        first sampled token + prefix accounting + `retired` when the
+        request finished at prefill). Raises AssertionError when no slot
+        is free (check `free_slots`) and `NoFreeBlocks` when the pool
+        cannot cover the suffix even after evicting every unreferenced
+        cached block — the caller keeps the request queued and admits
+        again after a retirement."""
         free = self.free_slots
         assert free, "no free slot — step()/retire before admitting"
         assert max_new_tokens >= 1
@@ -376,8 +497,30 @@ class DecodeEngine:
         # keep at least one free cache row to decode into
         toks = toks[-(self.max_len - 1):]
         L = len(toks)
-        bucket = self.prefill_bucket(L)
-        padded = jnp.asarray(toks + [0] * (bucket - L), jnp.int32)[None]
+        bs = self.block_size
+        prefix_len, matched = self._match_prefix(toks)
+        suffix = toks[prefix_len:]
+        bucket = min(self.prefill_bucket(len(suffix)),
+                     self.max_len - prefix_len)
+        # take prefix refs BEFORE allocating: alloc may evict from the
+        # LRU, and a matched block must not be the one evicted
+        for blk in matched:
+            self.block_pool.ref(blk)
+        new_ids = self.block_pool.alloc_many(bucket // bs)
+        if new_ids is None:
+            self.block_pool.release_all(matched)
+            raise NoFreeBlocks(
+                f"pool exhausted: {self.block_pool.n_referenced} of "
+                f"{self.block_pool.capacity} blocks referenced by "
+                f"{self.n_live} live sequences; admit after a retirement")
+        blocks = matched + new_ids
+        self._tables_h[slot, :] = 0
+        self._tables_h[slot, :len(blocks)] = blocks
+        self._tables_dirty = True
+        self._sync_tables()
+
+        padded = jnp.asarray(suffix + [0] * (bucket - len(suffix)),
+                             jnp.int32)[None]
         if seq_id is None:
             seq_id = self._next_id
         self._next_id = max(self._next_id, seq_id) + 1
@@ -386,14 +529,24 @@ class DecodeEngine:
         with self._ctx():
             out = self._get_admit_fn(bucket)(
                 self.variables, self.caches, self.tok, self.pos, self.live,
-                padded, jnp.asarray([L], jnp.int32),
+                self.block_tables, padded, jnp.int32(prefix_len),
+                jnp.asarray([len(suffix)], jnp.int32),
                 jnp.int32(slot), rng)
         self.caches, self.tok, self.pos, self.live, first = out
         first_tok = int(jax.device_get(first)[0])
         self._slots[slot] = _Slot(seq_id=seq_id, tokens=toks + [first_tok],
                                   prompt_len=L, n_new=1,
-                                  max_new=max_new_tokens, pos=L)
+                                  max_new=max_new_tokens, pos=L,
+                                  blocks=blocks, order=self.n_admitted)
         self.n_admitted += 1
+        self.prompt_tokens += L
+        self.prefix_hit_tokens += prefix_len
+        self.prefilled_tokens += len(suffix)
+        # publish the prompt's full blocks now — immutable as of this
+        # prefill — so concurrent same-prefix requests hit immediately
+        if self.prefix_cache:
+            for key, blk in zip(chain_keys(toks, bs, L // bs), blocks):
+                self.block_pool.register(blk, key)
         # a 1-token request (or instant EOS) finishes at admission
         retired = None
         reason = self._retire_reason(slot, first_tok)
@@ -401,22 +554,63 @@ class DecodeEngine:
             retired = self._retire(slot, reason)
             self.live = self.live.at[slot].set(False)
         return Admission(seq_id=seq_id, first_token=first_tok,
-                         retired=retired)
+                         retired=retired, prefix_len=prefix_len,
+                         prefilled=len(suffix))
+
+    def _pick_victim(self) -> int:
+        """Slot of the youngest-admitted live sequence — the vLLM-style
+        recompute-preemption order: the last one in has the least sunk
+        decode work and the best chance of a prefix hit on resume."""
+        return max(self._slots, key=lambda s: self._slots[s].order)
+
+    def _ensure_blocks(self) -> dict:
+        """Grow every live sequence's block list to cover its next write;
+        when the pool is dry (all blocks referenced), preempt
+        youngest-first until the allocation succeeds. Returns
+        {seq_id: Retired(reason='preempted')} for the victims."""
+        preempted: dict[int, Retired] = {}
+        for slot in sorted(self._slots):
+            seq = self._slots.get(slot)
+            while seq is not None and \
+                    seq.pos >= len(seq.blocks) * self.block_size:
+                blk = self.block_pool.alloc()
+                if blk is not None:
+                    self._tables_h[slot, len(seq.blocks)] = blk
+                    seq.blocks.append(blk)
+                    self._tables_dirty = True
+                    continue
+                victim = self._pick_victim()
+                vseq = self._slots[victim]
+                preempted[vseq.seq_id] = self._retire(victim, "preempted")
+                if victim == slot:
+                    seq = None       # preempted itself; stop growing it
+        if preempted:
+            mask = np.zeros((self.n_slots,), bool)
+            mask[list(self._slots)] = True
+            self.live = jnp.asarray(mask)
+        return preempted
 
     def step(self) -> StepResult:
         """Advance every live slot one token. Returns a `StepResult`:
         {seq_id: token} sampled this step, plus {seq_id: Retired} for the
-        sequences that finished (with WHY — eos | budget | cache_full)."""
+        sequences that finished (with WHY — eos | budget | cache_full |
+        preempted; preempted ones yielded their blocks BEFORE the step and
+        emit no token — requeue them)."""
         if not self._slots:
             return StepResult({}, {})
+        preempted = self._ensure_blocks()
+        if not self._slots:
+            return StepResult({}, preempted)
+        self._sync_tables()
         with self._ctx():
             self.caches, self.tok, self.pos = self._get_step_fn()(
                 self.variables, self.caches, self.tok, self.pos, self.live,
-                self._rng, jnp.int32(self._t), self._qparams)
+                self.block_tables, self._rng, jnp.int32(self._t),
+                self._qparams)
         self._t += 1
         sampled = jax.device_get(self.tok)
         emitted: dict[int, int] = {}
-        retired: dict[int, Retired] = {}
+        retired: dict[int, Retired] = dict(preempted)
         for slot in list(self._slots):
             seq = self._slots[slot]
             nxt = int(sampled[slot])
@@ -427,9 +621,9 @@ class DecodeEngine:
             reason = self._retire_reason(slot, nxt)
             if reason is not None:
                 retired[seq.seq_id] = self._retire(slot, reason)
-        # drop retired slots from the live mask (their device rows stay —
-        # masked until the next occupant overwrites them)
-        if retired:
+        # drop retired slots from the live mask (their table rows are
+        # zeroed, so any residual write lands in the null block)
+        if len(retired) > len(preempted):
             mask = np.zeros((self.n_slots,), bool)
             mask[list(self._slots)] = True
             self.live = jnp.asarray(mask)
@@ -438,28 +632,46 @@ class DecodeEngine:
     def run(self, prompts, max_new_tokens,
             progress=None) -> list[list]:
         """Decode a whole batch of prompts with continuous batching: admit
-        as slots free up, step until everything retires. Returns prompt +
-        generated tokens per input, in input order. `max_new_tokens` is a
-        shared int or a per-prompt list (the serving parity tests replay
-        mixed budgets offline through this path)."""
+        as slots (and blocks) free up, step until everything retires,
+        REQUEUE preempted sequences at the head with their remaining
+        budget. Returns prompt + generated tokens per input, in input
+        order. `max_new_tokens` is a shared int or a per-prompt list (the
+        serving parity tests replay mixed budgets offline through this
+        path)."""
         budgets = (list(max_new_tokens)
                    if isinstance(max_new_tokens, (list, tuple))
                    else [max_new_tokens] * len(prompts))
         assert len(budgets) == len(prompts)
-        pending = list(zip(range(len(prompts)), prompts, budgets))
+        pending = [(i, p, b) for i, p, b in
+                   zip(range(len(prompts)), prompts, budgets)]
         results: dict[int, list] = {}
+        generated: dict[int, int] = dict.fromkeys(range(len(prompts)), 0)
         idx_for: dict[int, int] = {}
         while pending or self._slots:
             while pending and self.free_slots:
-                i, p, b = pending.pop(0)
-                adm = self.admit(p, b)
+                i, p, b = pending[0]
+                try:
+                    adm = self.admit(p, b)
+                except NoFreeBlocks:
+                    assert self._slots, \
+                        "pool exhausted with no live sequence to retire"
+                    break                      # step; retirements free blocks
+                pending.pop(0)
                 idx_for[adm.seq_id] = i
                 if adm.retired is not None:  # finished at prefill
                     results[i] = adm.retired.tokens
             t0 = time.perf_counter()
             if self._slots:
                 for sid, ret in self.step().retired.items():
-                    results[idx_for[sid]] = ret.tokens
+                    i = idx_for.pop(sid)
+                    generated[i] += len(ret.tokens) - ret.prompt_len
+                    if ret.reason == "preempted":
+                        # resume later from the retained prefix blocks:
+                        # resubmit everything so far as the new prompt
+                        pending.insert(0, (i, ret.tokens,
+                                           budgets[i] - generated[i]))
+                    else:
+                        results[i] = ret.tokens
             if progress is not None:
                 progress(self.n_live, time.perf_counter() - t0)
         return [results[i] for i in range(len(prompts))]

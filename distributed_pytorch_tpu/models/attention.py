@@ -34,6 +34,15 @@ TPU-first design notes (intentional divergences, documented per SURVEY §7):
 3. Functional, static-shape KV caches: fixed (B, S_max, ...) buffers updated
    with `dynamic_update_slice` at position `pos`, because XLA requires static
    shapes — replacing the reference's concat-and-grow caches (model.py:137-142).
+
+4. Paged decode caches (ops/block_pool.py): when `block_tables` is passed,
+   the cache leaves are (n_blocks, block_size, ...) POOLS shared by every
+   sequence, and writes/reads indirect through per-sequence block tables —
+   `paged_update` replaces the ring write, the flash kernel prefetches the
+   table, and the naive/absorbed paths read a `paged_gather`ed logical view
+   (identical values at identical logical positions, so they are
+   bit-compatible with the contiguous cache). The contiguous path below
+   stays for training and the one-shot generate oracle.
 """
 
 from __future__ import annotations
@@ -131,7 +140,7 @@ class GQA(nn.Module):
 
     @nn.compact
     def __call__(self, x, freqs, cache: Optional[Cache] = None, pos=0, *,
-                 deterministic: bool = True):
+                 deterministic: bool = True, block_tables=None):
         cfg = self.config
         B, T, C = x.shape
         nh, nkvh, hs = cfg.n_head, cfg.n_kv_heads, cfg.head_size
@@ -151,33 +160,45 @@ class GQA(nn.Module):
         q_offset = 0
         k_scale = v_scale = None
         if cache is not None:
+            # paged caches write through the block table, contiguous ones
+            # through the O(1) ring write — same rows, one indirection
+            upd = _update_cache
+            if block_tables is not None:
+                from distributed_pytorch_tpu.ops.block_pool import \
+                    paged_update
+
+                def upd(arr, new, p):
+                    return paged_update(arr, new, p, block_tables)
             if "k_scale" in cache:
-                # int8 cache: quantize on the ring write — codes land in
-                # the int8 buffers, per-(row, kv-head) scales in the f32
-                # sidecars, all via the same O(1) slot writes
+                # int8 cache: quantize on the write — codes land in the
+                # int8 buffers, per-(row, kv-head) scales in the f32
+                # sidecars, all via the same O(1) row writes
                 from distributed_pytorch_tpu.ops.quant import quantize_kv
                 k_q, k_s = quantize_kv(k)
                 v_q, v_s = quantize_kv(v)
-                k = _update_cache(cache["k"], k_q, pos)
-                v = _update_cache(cache["v"], v_q, pos)
-                k_scale = _update_cache(cache["k_scale"], k_s, pos)
-                v_scale = _update_cache(cache["v_scale"], v_s, pos)
+                k = upd(cache["k"], k_q, pos)
+                v = upd(cache["v"], v_q, pos)
+                k_scale = upd(cache["k_scale"], k_s, pos)
+                v_scale = upd(cache["v_scale"], v_s, pos)
                 new_cache = {"k": k, "k_scale": k_scale,
                              "v": v, "v_scale": v_scale}
             else:
-                k = _update_cache(cache["k"], k, pos)
-                v = _update_cache(cache["v"], v, pos)
+                k = upd(cache["k"], k, pos)
+                v = upd(cache["v"], v, pos)
                 new_cache = {"k": k, "v": v}
             q_offset = pos
 
         drop_rng = None
         if cfg.dropout > 0.0 and not deterministic:
             drop_rng = self.make_rng("dropout")
-        y = sdpa(q, k if k_scale is not None else k.astype(q.dtype),
-                 v if v_scale is not None else v.astype(q.dtype),
+        y = sdpa(q, k if (k_scale is not None or block_tables is not None)
+                 else k.astype(q.dtype),
+                 v if (v_scale is not None or block_tables is not None)
+                 else v.astype(q.dtype),
                  causal=True, q_offset=q_offset, dropout_rate=cfg.dropout,
                  dropout_rng=drop_rng, impl=self.attn_impl,
-                 decode=cache is not None, k_scale=k_scale, v_scale=v_scale)
+                 decode=cache is not None, k_scale=k_scale, v_scale=v_scale,
+                 block_tables=block_tables)
         y = y.reshape(B, T, C)
         y = _OverlapDense(C, x.dtype, name="c_proj")(y)
         y = nn.Dropout(cfg.dropout, deterministic=deterministic)(y)
@@ -269,7 +290,7 @@ class NaiveMLA(nn.Module):
 
     @nn.compact
     def __call__(self, x, freqs, cache: Optional[Cache] = None, pos=0, *,
-                 deterministic: bool = True):
+                 deterministic: bool = True, block_tables=None):
         cfg = self.config
         B, T, C = x.shape
         nh, hs = cfg.n_head, cfg.head_size
@@ -292,8 +313,18 @@ class NaiveMLA(nn.Module):
             y = y.reshape(B, T, C)
             new_cache = None
         else:
-            c_kv = _update_cache(cache["c_kv"], new_c_kv, pos)
-            new_cache = {"c_kv": c_kv}
+            if block_tables is not None:
+                from distributed_pytorch_tpu.ops.block_pool import (
+                    paged_gather, paged_update)
+                pool = paged_update(cache["c_kv"], new_c_kv, pos,
+                                    block_tables)
+                new_cache = {"c_kv": pool}
+                # absorbed decode attends the logical view; rows past each
+                # sequence's extent are causally masked to weight 0
+                c_kv = paged_gather(pool, block_tables)
+            else:
+                c_kv = _update_cache(cache["c_kv"], new_c_kv, pos)
+                new_cache = {"c_kv": c_kv}
             from distributed_pytorch_tpu.ops.quant import \
                 maybe_dequantized_param
             kuk = maybe_dequantized_param((*self.path, "W_uk"), ks["W_uk"])
@@ -322,7 +353,7 @@ class FullMLA(nn.Module):
 
     @nn.compact
     def __call__(self, x, freqs, cache: Optional[Cache] = None, pos=0, *,
-                 deterministic: bool = True):
+                 deterministic: bool = True, block_tables=None):
         cfg = self.config
         B, T, C = x.shape
         nh, hs = cfg.n_head, cfg.head_size
@@ -363,9 +394,20 @@ class FullMLA(nn.Module):
             y = y[..., :hs].reshape(B, T, C)
             new_cache = None
         else:
-            c_kv = _update_cache(cache["c_kv"], new_c_kv, pos)
-            k_r = _update_cache(cache["k_r"], new_k_r, pos)
-            new_cache = {"c_kv": c_kv, "k_r": k_r}
+            if block_tables is not None:
+                from distributed_pytorch_tpu.ops.block_pool import (
+                    paged_gather, paged_update)
+                ckv_pool = paged_update(cache["c_kv"], new_c_kv, pos,
+                                        block_tables)
+                kr_pool = paged_update(cache["k_r"], new_k_r, pos,
+                                       block_tables)
+                new_cache = {"c_kv": ckv_pool, "k_r": kr_pool}
+                c_kv = paged_gather(ckv_pool, block_tables)
+                k_r = paged_gather(kr_pool, block_tables)
+            else:
+                c_kv = _update_cache(cache["c_kv"], new_c_kv, pos)
+                k_r = _update_cache(cache["k_r"], new_k_r, pos)
+                new_cache = {"c_kv": c_kv, "k_r": k_r}
             # decoupled-rotary scores; single shared key head broadcasts
             attn_r = jnp.einsum("btnh,bskh->bnts", q_r, k_r.astype(dt))
             from distributed_pytorch_tpu.ops.quant import \
@@ -420,4 +462,31 @@ def init_attn_cache(config: LLMConfig, batch_size: int, max_len: int,
     cache = {"c_kv": jnp.zeros((B, S, config.kv_latent_dim), dtype)}
     if config.pos_emb == "rope":
         cache["k_r"] = jnp.zeros((B, S, 1, config.rope_head_dim), dtype)
+    return cache
+
+
+def init_paged_attn_cache(config: LLMConfig, n_blocks: int, block_size: int,
+                          dtype=jnp.float32) -> Cache:
+    """Per-layer paged KV POOL buffers (module docstring note 4): the same
+    leaves as `init_attn_cache` with the (B, S) row axes replaced by
+    (n_blocks, block_size) — `sharding.decode_cache_pspec` still places
+    the kv-head axis over 'model' and the leading (now block) axis over
+    'data'. Block 0 is the null block (ops/block_pool.py)."""
+    nb, bs = n_blocks, block_size
+    if config.attn in ("mha", "mqa", "gqa"):
+        shape = (nb, bs, config.n_kv_heads, config.head_size)
+        if jnp.dtype(dtype) == jnp.int8:
+            sc = (nb, bs, config.n_kv_heads, 1)
+            return {"k": jnp.zeros(shape, jnp.int8),
+                    "k_scale": jnp.zeros(sc, jnp.float32),
+                    "v": jnp.zeros(shape, jnp.int8),
+                    "v_scale": jnp.zeros(sc, jnp.float32)}
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    if jnp.dtype(dtype) == jnp.int8:
+        raise ValueError(
+            "int8 KV cache supports the GQA family only (quant_kv_usable "
+            "gates this; MLA latent caches stay in the compute dtype)")
+    cache = {"c_kv": jnp.zeros((nb, bs, config.kv_latent_dim), dtype)}
+    if config.pos_emb == "rope":
+        cache["k_r"] = jnp.zeros((nb, bs, 1, config.rope_head_dim), dtype)
     return cache

@@ -58,7 +58,8 @@ class Block(nn.Module):
     remat_attn: bool = False
 
     @nn.compact
-    def __call__(self, x, freqs, cache=None, pos=0, stats_weight=None):
+    def __call__(self, x, freqs, cache=None, pos=0, stats_weight=None,
+                 block_tables=None):
         cfg = self.config
         deterministic = self.deterministic
         ln1 = nn.LayerNorm(dtype=x.dtype, param_dtype=jnp.float32, name="ln1")
@@ -70,12 +71,14 @@ class Block(nn.Module):
             # cheap) so the flavor modules' keyword-only `deterministic`
             # needs no static-argnum plumbing. Param path stays `attn`.
             def attn_fn(mdl, h):
-                return mdl(h, freqs, cache, pos, deterministic=deterministic)
+                return mdl(h, freqs, cache, pos, deterministic=deterministic,
+                           block_tables=block_tables)
             attn_out, new_cache = nn.remat(attn_fn, prevent_cse=False)(
                 attn, ln1(x))
         else:
             attn_out, new_cache = attn(ln1(x), freqs, cache, pos,
-                                       deterministic=deterministic)
+                                       deterministic=deterministic,
+                                       block_tables=block_tables)
         x = x + attn_out
         if cfg.moe:
             moe_out, aux_loss = MoE(cfg, name="moe")(
@@ -109,13 +112,17 @@ class LLM(nn.Module):
 
     @nn.compact
     def __call__(self, idx, targets=None, caches=None, pos=0, *,
-                 deterministic: bool = True, logits_idx=None):
+                 deterministic: bool = True, logits_idx=None,
+                 block_tables=None):
         """`pos` is the global position of idx[:, 0] — a static int, a
         traced scalar, or a per-sequence (B,) array (slot-based ragged
         decode; each sequence in the batch sits at its own cache
         position). `logits_idx` (B,) selects which position's logits to
         return when targets is None (default: the last) — the bucketed
-        prefill path, where right-padded prompts end at different rows."""
+        prefill path, where right-padded prompts end at different rows.
+        `block_tables` (B, max_blocks) int32 marks the caches as PAGED
+        pools (init_paged_cache); reads and writes then indirect through
+        the table (ops/block_pool.py)."""
         cfg = self.config
         B, T = idx.shape
         dt = self.compute_dtype
@@ -174,7 +181,8 @@ class LLM(nn.Module):
             for i in range(cfg.n_layer):
                 blk = block_cls(cfg, self.attn_impl, deterministic,
                                 remat_attn, name=f"block_{i}")
-                x, new_cache, aux = blk(x, freqs, caches[i], pos)
+                x, new_cache, aux = blk(x, freqs, caches[i], pos,
+                                        block_tables=block_tables)
                 new_caches.append(new_cache)
                 total_aux = total_aux + aux
 
@@ -289,6 +297,18 @@ def init_cache(config: LLMConfig, batch_size: int,
     """
     max_len = max_len or config.block_size
     return [init_attn_cache(config, batch_size, max_len, dtype)
+            for _ in range(config.n_layer)]
+
+
+def init_paged_cache(config: LLMConfig, n_blocks: int, block_size: int,
+                     dtype=jnp.float32):
+    """Per-layer paged KV-cache pytree: one (n_blocks, block_size, ...)
+    pool set per layer, shared by every sequence through per-sequence
+    block tables (engine/decode.py owns the tables; one table serves all
+    layers because block ids are allocated for the whole layer stack at
+    once). Pass the tables to `LLM.__call__(block_tables=...)`."""
+    from distributed_pytorch_tpu.models.attention import init_paged_attn_cache
+    return [init_paged_attn_cache(config, n_blocks, block_size, dtype)
             for _ in range(config.n_layer)]
 
 

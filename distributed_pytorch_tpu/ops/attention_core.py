@@ -126,7 +126,8 @@ def sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
          impl: str = "auto",
          decode: bool = False,
          k_scale: Optional[jnp.ndarray] = None,
-         v_scale: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+         v_scale: Optional[jnp.ndarray] = None,
+         block_tables: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Scaled dot-product attention over (B, T, N, H)-layout tensors.
 
     `q_offset` is the global position of q[:, 0] (nonzero during KV-cached
@@ -140,6 +141,14 @@ def sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
     (ops/quant.py): k/v hold int8 codes. The flash-decode kernel
     dequantizes in VMEM (half the cache DMA); every other path
     dequantizes the buffers up front and proceeds unchanged.
+
+    `block_tables` (B, max_blocks) int32 marks k/v (and the scale
+    sidecars) as PAGED pools (ops/block_pool.py): single-token decode
+    routes to the paged flash kernel (block-table scalar prefetch — no
+    gather, no full-buffer stream); every other path materializes the
+    logical per-sequence view with one `paged_gather` and proceeds
+    unchanged — the gathered view holds identical values at identical
+    logical positions, so downstream numerics match the contiguous cache.
     """
     hs = q.shape[-1]
     scale = (1.0 / hs ** 0.5) if scale is None else scale
@@ -151,6 +160,34 @@ def sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                          "'zigzag' | 'ulysses'")
 
     use_dropout = dropout_rate > 0.0 and dropout_rng is not None
+
+    if block_tables is not None:
+        # paged KV cache: kernel first (single-token decode), else gather
+        # the logical view and fall through to the shared routing below
+        if (decode and causal and q.shape[1] == 1 and not use_dropout
+                and impl in ("auto", "pallas", "xla")):
+            from distributed_pytorch_tpu.ops.flash_decode import (
+                decode_mode, paged_flash_decode, paged_flash_decode_usable)
+            mode = decode_mode()
+            if (mode == "on" or (mode == "auto" and _on_tpu())) \
+                    and paged_flash_decode_usable(q, k, v, block_tables):
+                cl = jnp.broadcast_to(jnp.reshape(
+                    jnp.asarray(q_offset, jnp.int32), (-1,)) + 1,
+                    (q.shape[0],))
+                out = paged_flash_decode(q[:, 0], k, v, block_tables, cl,
+                                         scale=scale, k_scale=k_scale,
+                                         v_scale=v_scale,
+                                         interpret=not _on_tpu())
+                return out[:, None]
+        from distributed_pytorch_tpu.ops.block_pool import paged_gather
+        k = paged_gather(k, block_tables)
+        v = paged_gather(v, block_tables)
+        if k_scale is not None:
+            k_scale = paged_gather(k_scale, block_tables)
+            v_scale = paged_gather(v_scale, block_tables)
+        if k.dtype != jnp.int8:
+            k = k.astype(q.dtype)
+            v = v.astype(q.dtype)
 
     # KV-cached single-token decode: the memory-bound fast path. The
     # split-KV Pallas kernel (ops/flash_decode.py) streams each sequence's

@@ -1,0 +1,263 @@
+"""Paged KV-cache block pool: host-side allocator + device-side ops.
+
+The fixed (n_slots, S) slot cache pays for the worst case twice: HBM holds
+S rows per slot even when the mean sequence is a tenth of that, and a
+prompt shared by a thousand requests is prefilled a thousand times. The
+vLLM treatment (PagedAttention; PAPERS.md) fixes both with one level of
+indirection: KV rows live in fixed-size BLOCKS drawn from a global pool,
+each sequence owns an ordered list of block ids (its *block table*), and
+immutable full blocks are content-addressed so identical prompt prefixes
+resolve to the *same* physical blocks.
+
+Three layers, smallest first:
+
+* **Device ops** (`paged_update`, `paged_gather`): the (n_blocks, bs, ...)
+  pool is a plain jax array; a token write is a 2-index scatter through
+  the block table (the paged generalization of models/attention.py's O(1)
+  ring write), a logical view for the naive/einsum attention paths is one
+  advanced-indexing gather — the same bytes the slot cache streamed. The
+  flash path skips the gather entirely: ops/flash_decode.py's paged kernel
+  DMAs blocks straight from the pool through a block-table scalar
+  prefetch. Physical block 0 is the NULL block: retired slots' table rows
+  are zeroed, so the fused step's unavoidable dead-slot write lands in a
+  row nothing ever reads — the paged replacement for "masked until the
+  next occupant overwrites".
+* **`BlockPool`**: free-list allocator with per-block refcounts. Blocks
+  referenced by live sequences can be shared (a reused prefix); blocks at
+  refcount 0 that are *registered* in the prefix index are retained on an
+  LRU instead of freed — `alloc()` evicts the oldest only when the free
+  list is dry, so HBM that would sit idle caches prefixes for free.
+  `alloc()` returning None (everything referenced) is the engine's
+  preemption trigger.
+* **Prefix index** (`lookup`/`register`): content-addressed full blocks
+  keyed by the CHAIN (parent_key, block_tokens) — a flattened radix tree:
+  looking up a prompt walks key-by-key from the root, so a hit at depth d
+  proves the whole d-block prefix matches and an evicted ancestor
+  automatically unreaches its descendants (they age out of the LRU).
+  Only FULL blocks are ever registered; the partial tail of a sequence is
+  always private — sharing is copy-on-write at block granularity (a fork
+  allocates a fresh tail block instead of appending to a shared one).
+
+Everything host-side is plain Python on the engine's single thread — the
+allocator is bookkeeping, never a device sync.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+
+#: physical block 0 is never allocated: zeroed table rows route dead-slot
+#: writes here (see module docstring)
+NULL_BLOCK = 0
+
+
+class NoFreeBlocks(RuntimeError):
+    """The pool has no free or evictable block — every block is referenced
+    by a live sequence. At admission this means "stay queued"; during
+    decode the engine preempts a victim instead."""
+
+
+# ---------------------------------------------------------------------------
+# device-side paged-cache ops
+# ---------------------------------------------------------------------------
+
+def paged_update(pool: jnp.ndarray, new: jnp.ndarray, pos,
+                 block_tables: jnp.ndarray) -> jnp.ndarray:
+    """Write `new` (B, T, ...) rows into the (n_blocks, bs, ...) pool at
+    logical positions [pos, pos+T) of each sequence, addressed through
+    `block_tables` (B, max_blocks) int32.
+
+    Two shapes, mirroring `_update_cache`'s prefill/decode split:
+    * T == 1 (fused decode step): `pos` is per-sequence (B,); one 2-index
+      scatter writes every live slot's row. Tail blocks are never shared,
+      so concurrent writers cannot collide (dead slots all land in the
+      null block — harmless, nothing reads it).
+    * T > 1 (bucketed prefill): B == 1, `pos` a block-aligned scalar (the
+      reused-prefix length), T a multiple of the block size; whole blocks
+      are scattered in one shot. Pad rows land in blocks private to this
+      sequence and are causally masked exactly as in the slot cache.
+    """
+    new = new.astype(pool.dtype)
+    B, T = new.shape[:2]
+    bs = pool.shape[1]
+    if T == 1:
+        p = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (B,))
+        blk = jnp.take_along_axis(block_tables, (p // bs)[:, None],
+                                  axis=1)[:, 0]
+        return pool.at[blk, p % bs].set(new[:, 0], mode="drop")
+    assert B == 1, "paged prefill writes one sequence at a time"
+    assert T % bs == 0, f"prefill length {T} not a multiple of block {bs}"
+    p0 = jnp.asarray(pos, jnp.int32).reshape(())
+    nblk = T // bs
+    blks = jax.lax.dynamic_slice(block_tables[0], (p0 // bs,), (nblk,))
+    vals = new[0].reshape((nblk, bs) + new.shape[2:])
+    return pool.at[blks].set(vals, mode="drop")
+
+
+def paged_gather(pool: jnp.ndarray, block_tables: jnp.ndarray) -> jnp.ndarray:
+    """Materialize the logical (B, max_blocks*bs, ...) view of each
+    sequence's cache for the naive/einsum attention paths. Rows past a
+    sequence's extent map through null/stale blocks and carry garbage —
+    exactly like the slot cache's retired rows, they are causally masked
+    to weight 0.0 before they can touch the output."""
+    B, n_max = block_tables.shape
+    g = pool[block_tables]                      # (B, n_max, bs, ...)
+    return g.reshape((B, n_max * pool.shape[1]) + pool.shape[2:])
+
+
+# ---------------------------------------------------------------------------
+# host-side allocator + prefix index
+# ---------------------------------------------------------------------------
+
+#: chain key of the empty prefix (the radix root)
+ROOT_KEY = ()
+
+
+def chain_keys(tokens, block_size: int, n_blocks: int,
+               parent=ROOT_KEY) -> list:
+    """Chain keys for the first `n_blocks` FULL blocks of `tokens`:
+    key_i = (key_{i-1}, tokens of block i). A key encodes the whole
+    prefix up to and including its block, so equal keys imply equal
+    content at equal positions."""
+    keys = []
+    for i in range(n_blocks):
+        parent = (parent, tuple(tokens[i * block_size:(i + 1) * block_size]))
+        keys.append(parent)
+    return keys
+
+
+class BlockPool:
+    """Refcounted block allocator with an LRU prefix cache.
+
+    Block states (disjoint):
+    * free        — on the free list, content garbage;
+    * referenced  — refcount >= 1 live sequences own it (possibly shared);
+    * cached      — refcount 0 but registered in the prefix index: content
+                    retained, evictable LRU-first when the free list runs
+                    dry.
+
+    The null block (id 0) is reserved and never enters any state.
+    """
+
+    def __init__(self, n_blocks: int, block_size: int):
+        assert n_blocks >= 2, "pool needs the null block plus one real one"
+        self.n_blocks = n_blocks
+        self.block_size = block_size
+        self._free: collections.deque[int] = collections.deque(
+            range(1, n_blocks))
+        self._ref: dict[int, int] = {}           # block -> refcount (>= 1)
+        self._key_of: dict[int, tuple] = {}      # registered block -> key
+        self._index: dict[tuple, int] = {}       # chain key -> block
+        self._lru: collections.OrderedDict[int, None] = \
+            collections.OrderedDict()            # cached blocks, oldest first
+        # lifetime counters (engine metrics read these)
+        self.n_evicted = 0
+        self.n_allocs = 0
+
+    # -- capacity accounting -------------------------------------------
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_cached(self) -> int:
+        return len(self._lru)
+
+    @property
+    def n_referenced(self) -> int:
+        return len(self._ref)
+
+    @property
+    def capacity(self) -> int:
+        """Allocatable blocks (the null block is not one)."""
+        return self.n_blocks - 1
+
+    @property
+    def utilization(self) -> float:
+        """Referenced fraction of the pool (cached blocks are reclaimable,
+        so they don't count as used)."""
+        return self.n_referenced / self.capacity if self.capacity else 0.0
+
+    # -- alloc / free ---------------------------------------------------
+    def alloc(self) -> Optional[int]:
+        """A fresh private block (refcount 1), evicting the LRU cached
+        block when the free list is empty. None when every block is
+        referenced — the caller preempts or stays queued."""
+        if self._free:
+            blk = self._free.popleft()
+        elif self._lru:
+            blk, _ = self._lru.popitem(last=False)   # oldest cached
+            self._index.pop(self._key_of.pop(blk), None)
+            self.n_evicted += 1
+        else:
+            return None
+        self._ref[blk] = 1
+        self.n_allocs += 1
+        return blk
+
+    def alloc_many(self, n: int) -> Optional[list[int]]:
+        """n fresh blocks or None (all-or-nothing: a partial admission
+        would leak refs)."""
+        got: list[int] = []
+        for _ in range(n):
+            blk = self.alloc()
+            if blk is None:
+                for b in got:
+                    self.release(b)
+                return None
+            got.append(blk)
+        return got
+
+    def ref(self, blk: int) -> None:
+        """Take a reference on a cached or already-referenced block (a
+        prefix hit sharing it with a new sequence)."""
+        if blk in self._ref:
+            self._ref[blk] += 1
+            return
+        assert blk in self._lru, f"block {blk} is neither live nor cached"
+        del self._lru[blk]
+        self._ref[blk] = 1
+
+    def release(self, blk: int) -> None:
+        """Drop one reference. At refcount 0 a registered block is
+        retained on the LRU (prefix cache); an unregistered one goes back
+        to the free list."""
+        n = self._ref[blk] - 1
+        if n:
+            self._ref[blk] = n
+            return
+        del self._ref[blk]
+        if blk in self._key_of:
+            self._lru[blk] = None                # most-recently released
+        else:
+            self._free.append(blk)
+
+    def release_all(self, blocks: Iterable[int]) -> None:
+        """Release a sequence's blocks tail-first, so when eviction comes
+        the deepest (least shareable) blocks go before their ancestors —
+        the chain walk needs ancestors to reach descendants at all."""
+        for blk in reversed(list(blocks)):
+            self.release(blk)
+
+    # -- prefix index ---------------------------------------------------
+    def lookup(self, key: tuple) -> Optional[int]:
+        """Block holding this chain key's content, or None. Touches the
+        LRU so a hit streak keeps a hot prefix resident."""
+        blk = self._index.get(key)
+        if blk is not None and blk in self._lru:
+            self._lru.move_to_end(blk)
+        return blk
+
+    def register(self, blk: int, key: tuple) -> None:
+        """Publish a full, immutable, referenced block under its chain
+        key. First writer wins: a concurrent identical prefill keeps its
+        private copy unregistered (it frees normally on release)."""
+        if key in self._index or blk in self._key_of:
+            return
+        assert blk in self._ref, "only referenced blocks can be registered"
+        self._index[key] = blk
+        self._key_of[blk] = key

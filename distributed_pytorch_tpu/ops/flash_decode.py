@@ -253,6 +253,136 @@ def flash_decode(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return out.reshape(B, nh, hs)
 
 
+def _paged_body(cl_ref, bt_ref, *args, scale: float, block_s: int):
+    """Paged bf16 kernel: identical online-softmax body — the block table
+    ref is consumed by the index maps only."""
+    del bt_ref
+    _kernel(cl_ref, *args, scale=scale, block_s=block_s)
+
+
+def _paged_body_q8(cl_ref, bt_ref, *args, scale: float, block_s: int):
+    del bt_ref
+    _kernel_q8(cl_ref, *args, scale=scale, block_s=block_s)
+
+
+def paged_flash_decode(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                       block_tables: jnp.ndarray, cache_len: jnp.ndarray, *,
+                       scale: float, k_scale: jnp.ndarray = None,
+                       v_scale: jnp.ndarray = None,
+                       interpret: bool = False) -> jnp.ndarray:
+    """Single-token cached attention over a PAGED cache: q (B, nh, hs)
+    against (n_blocks, bs, n_kv, hs) pool buffers (ops/block_pool.py),
+    with per-sequence block tables (B, max_blocks) int32 and valid
+    lengths `cache_len` (B,). Returns (B, nh, hs).
+
+    This is the contiguous kernel's `cache_len` scalar-prefetch
+    generalized by ONE indirection: the grid walks each sequence's
+    logical blocks (grid dim 1 = max_blocks) and the kv index map
+    resolves logical j -> physical pool block through the prefetched
+    table. The dead-block machinery is unchanged — steps past a
+    sequence's last valid block clamp to it, the revolving-buffer DMA
+    sees an unchanged physical index and fetches nothing, and the last
+    partial block masks `kpos >= cache_len`. int8 pools bring their
+    scale-sidecar pools through the same index map. Gate with
+    `paged_flash_decode_usable`."""
+    B, nh, hs = q.shape
+    bs, nkv = k.shape[1], k.shape[2]
+    n_max = block_tables.shape[1]
+    rep = nh // nkv
+    quantized = k_scale is not None
+    assert quantized == (v_scale is not None), \
+        "int8 cache needs both k_scale and v_scale"
+
+    cl = jnp.asarray(cache_len, jnp.int32).reshape(B)
+    bt = jnp.asarray(block_tables, jnp.int32)
+    q4 = q.reshape(B, nkv, rep, hs)
+
+    def q_idx(b, j, cl_ref, bt_ref):
+        return (b, 0, 0, 0)
+
+    def kv_idx(b, j, cl_ref, bt_ref):
+        # clamp skipped steps to the last valid LOGICAL block, then map to
+        # its physical pool block: the revolving buffer sees an unchanged
+        # index -> no DMA for dead blocks (same trick as the contiguous
+        # kernel, one table lookup deeper)
+        last = jax.lax.div(jnp.maximum(cl_ref[b], 1) - 1, bs)
+        return (bt_ref[b, jnp.minimum(j, last)], 0, 0, 0)
+
+    in_specs = [pl.BlockSpec((1, nkv, rep, hs), q_idx)]
+    operands = [q4]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, bs, nkv, hs), kv_idx),
+            pl.BlockSpec((1, bs, nkv, 1), kv_idx),
+            pl.BlockSpec((1, bs, nkv, hs), kv_idx),
+            pl.BlockSpec((1, bs, nkv, 1), kv_idx),
+        ]
+        operands += [k, k_scale.astype(jnp.float32),
+                     v, v_scale.astype(jnp.float32)]
+        body = _paged_body_q8
+    else:
+        in_specs += [
+            pl.BlockSpec((1, bs, nkv, hs), kv_idx),
+            pl.BlockSpec((1, bs, nkv, hs), kv_idx),
+        ]
+        operands += [k, v]
+        body = _paged_body
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, n_max),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, nkv, rep, hs), q_idx),
+        scratch_shapes=[
+            pltpu.VMEM((nkv, rep, hs), jnp.float32),
+            pltpu.VMEM((nkv, rep, 1), jnp.float32),
+            pltpu.VMEM((nkv, rep, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(body, scale=float(scale), block_s=bs),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, nkv, rep, hs), q.dtype),
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(cl, bt, *operands)
+    return out.reshape(B, nh, hs)
+
+
+def paged_flash_decode_usable(q, k, v, block_tables) -> bool:
+    """Static gate for the paged kernel, mirroring `flash_decode_usable`:
+    decode-shaped (B, 1, nh, hs) query, pool block size the hardware
+    tiles (multiples of 128 rows on TPU — small CPU-test pages run in
+    interpret mode at multiples of 8), no live multi-device mesh. Callers
+    fall back to paged_gather + the naive path — identical semantics."""
+    if q.ndim != 4 or q.shape[1] != 1:
+        return False
+    B, _, nh, hs = q.shape
+    bs, nkv = k.shape[1], k.shape[2]
+    if q.dtype not in (jnp.float32, jnp.bfloat16):
+        return False
+    if k.dtype != q.dtype and k.dtype != jnp.int8:
+        return False
+    if hs % 8 != 0 or nh % nkv != 0:
+        return False
+    on_tpu = jax.default_backend() == "tpu"
+    if bs % (128 if on_tpu else 8) != 0:
+        return False
+    from distributed_pytorch_tpu.parallel import context
+    mesh = context.get_mesh()
+    if mesh is not None and any(s > 1 for s in mesh.devices.shape):
+        return False
+    dsize = jnp.dtype(k.dtype).itemsize
+    rep = nh // nkv
+    tiles = 2 * 2 * bs * nkv * hs * dsize               # double-buffered k+v
+    if k.dtype == jnp.int8:
+        tiles += 2 * 2 * bs * nkv * 4                   # f32 scale rows
+    scratch = nkv * rep * (hs + 2) * 4
+    scores = 3 * nkv * rep * bs * 4
+    return tiles + scratch + scores <= _VMEM_BUDGET
+
+
 def flash_decode_usable(q, k, v) -> bool:
     """Static gate for the dispatcher: (B, 1, nh, hs)-shaped decode query,
     dtypes/shapes the kernel tiles, no live multi-device mesh (GSPMD

@@ -49,6 +49,18 @@ def build_args(argv=None):
                    default="", choices=["", "int8", "bfloat16", "float32"])
     p.add_argument("--quant-weights", "--quant_weights",
                    dest="quant_weights", action="store_true")
+    p.add_argument("--kv-block", "--kv_block", dest="kv_block", type=int,
+                   default=None,
+                   help="paged-cache block size in KV rows (pow2; default "
+                        "16 — TPU serving wants 128+ so the paged flash "
+                        "kernel engages)")
+    p.add_argument("--kv-blocks", "--kv_blocks", dest="kv_blocks", type=int,
+                   default=None,
+                   help="block-pool size (train.memplan.plan_decode_blocks;"
+                        " default: slots x max_len worth of blocks)")
+    p.add_argument("--no-prefix-cache", dest="prefix_cache",
+                   action="store_false",
+                   help="disable radix prefix reuse (A/B baseline)")
     return p.parse_args(argv)
 
 
@@ -90,7 +102,9 @@ async def _amain(args) -> None:
                        temperature=args.temperature, top_k=args.top_k,
                        eos_id=args.eos_id,
                        rng=jax.random.PRNGKey(args.seed),
-                       mesh=mesh, recipe=recipe)
+                       mesh=mesh, recipe=recipe,
+                       block_size=args.kv_block, n_blocks=args.kv_blocks,
+                       prefix_cache=args.prefix_cache)
     sched = Scheduler(eng, max_queue=args.max_queue,
                       default_deadline_s=args.deadline_s)
     app = ServeApp(sched, host=args.host, port=args.port, encoder=encoder,
@@ -100,7 +114,9 @@ async def _amain(args) -> None:
     print(f"serving on http://{args.host}:{app.port} "
           f"(slots={args.slots}, queue<={args.max_queue}, "
           f"cache={'int8' if eng.kv_quantized else 'native'}, "
-          f"quant_w={eng.weights_quantized})")
+          f"quant_w={eng.weights_quantized}, "
+          f"blocks={eng.n_blocks}x{eng.block_size}, "
+          f"prefix_cache={eng.prefix_cache})")
     print(f"  curl -N -X POST http://{args.host}:{app.port}/v1/completions "
           "-d '{\"prompt\": [1, 2, 3], \"max_tokens\": 16}'")
     try:

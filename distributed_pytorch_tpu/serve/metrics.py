@@ -98,9 +98,14 @@ class Histogram:
 class ServeMetrics:
     """The scheduler/server's shared metrics registry."""
 
-    #: request lifecycle counters; 'shed' splits by cause in shed_counts
+    #: request lifecycle counters; 'shed' splits by cause in shed_counts.
+    #: 'preempted'/'requeued' track the paged pool's block-level
+    #: preemption (every preempted request is requeued, never lost);
+    #: 'prefix_hit_tokens'/'prefix_miss_tokens' split each admission's
+    #: prompt into reused-from-cached-blocks vs actually-prefilled tokens.
     COUNTERS = ("submitted", "admitted", "completed", "cancelled", "shed",
-                "tokens_out")
+                "tokens_out", "preempted", "requeued",
+                "prefix_hit_tokens", "prefix_miss_tokens")
 
     def __init__(self):
         self.ttft = Histogram(
@@ -154,9 +159,16 @@ class ServeMetrics:
         lines += ["# HELP serve_requests_total request lifecycle counters",
                   "# TYPE serve_requests_total counter"]
         for name in ("submitted", "admitted", "completed", "cancelled",
-                     "shed"):
+                     "shed", "preempted", "requeued"):
             lines.append(f'serve_requests_total{{event="{name}"}} '
                          f'{self.counters[name]}')
+        lines += ["# HELP serve_prefix_tokens_total prompt tokens served "
+                  "from cached prefix blocks (hit) vs prefilled (miss)",
+                  "# TYPE serve_prefix_tokens_total counter",
+                  f'serve_prefix_tokens_total{{kind="hit"}} '
+                  f"{self.counters['prefix_hit_tokens']}",
+                  f'serve_prefix_tokens_total{{kind="miss"}} '
+                  f"{self.counters['prefix_miss_tokens']}"]
         for cause, n in sorted(self.shed_counts.items()):
             lines.append(f'serve_shed_total{{cause="{cause}"}} {n}')
         for reason, n in sorted(self.retire_counts.items()):
@@ -190,4 +202,12 @@ class ServeMetrics:
             out["shed_by_cause"] = dict(self.shed_counts)
         if self.retire_counts:
             out["retired_by_reason"] = dict(self.retire_counts)
+        if self._gauges:
+            gauges = {}
+            for name, (fn, _) in sorted(self._gauges.items()):
+                try:
+                    gauges[name] = round(float(fn()), 4)
+                except Exception:  # pragma: no cover — gauge died
+                    gauges[name] = None
+            out["gauges"] = gauges
         return out

@@ -30,6 +30,14 @@ module gives the host side:
   `engine.cancel()` before the next step, so a cancelled request's slot
   is free within one fused step. Queued requests are cancelled in place
   without ever touching the engine.
+* **Preemption requeues, admission waits**: the paged engine retires a
+  sequence with reason 'preempted' when the block pool runs dry mid-
+  decode — the loop resubmits it at the queue HEAD (everything generated
+  so far becomes the new prompt; the retained prefix blocks make the
+  re-prefill a prefix-cache hit, and the stream just keeps going), so
+  preemption is never user-visible loss. `NoFreeBlocks` at admission
+  leaves the request queued until a retirement frees blocks — shed stays
+  reserved for admission-bound overflow (queue_full/deadline/shutdown).
 
 Threading contract: `submit`/`cancel` must be called on the event loop
 (the HTTP server does); only the background loop touches the engine, and
@@ -47,6 +55,7 @@ import time
 from typing import Optional
 
 from distributed_pytorch_tpu.engine.decode import Retired
+from distributed_pytorch_tpu.ops.block_pool import NoFreeBlocks
 from distributed_pytorch_tpu.serve.metrics import ServeMetrics
 
 
@@ -62,7 +71,7 @@ class ShedError(RuntimeError):
 @dataclasses.dataclass
 class _Request:
     prompt: list
-    max_new: int
+    max_new: int                  # budget for the NEXT admission
     deadline_s: Optional[float]
     submitted_at: float
     handle: "RequestHandle"
@@ -70,6 +79,12 @@ class _Request:
     admitted_at: Optional[float] = None
     last_tok_at: Optional[float] = None
     cancelled: bool = False
+    # preemption-resume bookkeeping: the caller-visible prompt length and
+    # total budget never change; `resumed` marks re-admissions (their
+    # queue wait is not a TTFT)
+    orig_prompt_len: int = 0
+    budget_total: int = 0
+    resumed: bool = False
 
 
 class RequestHandle:
@@ -176,6 +191,19 @@ class Scheduler:
         self.metrics.register_gauge(
             "serve_slots_free", lambda: self.engine.n_free,
             "free decode slots")
+        # paged-cache observability (engine/decode.py properties): how full
+        # the block pool runs, how much of it is partial-tail waste, and
+        # how often prompts resolve to cached prefix blocks
+        self.metrics.register_gauge(
+            "serve_block_utilization", lambda: self.engine.block_utilization,
+            "referenced fraction of the KV block pool")
+        self.metrics.register_gauge(
+            "serve_block_fragmentation",
+            lambda: self.engine.block_fragmentation,
+            "unwritten fraction of referenced KV block rows")
+        self.metrics.register_gauge(
+            "serve_prefix_hit_rate", lambda: self.engine.prefix_hit_rate,
+            "lifetime fraction of prompt tokens served from cached blocks")
 
     # ------------------------------------------------------------------
     # caller API (event-loop thread only)
@@ -211,7 +239,9 @@ class Scheduler:
             deadline_s = self.default_deadline_s
         req = _Request(prompt=[int(t) for t in prompt],
                        max_new=max_new_tokens, deadline_s=deadline_s,
-                       submitted_at=time.perf_counter(), handle=None)
+                       submitted_at=time.perf_counter(), handle=None,
+                       orig_prompt_len=len(prompt),
+                       budget_total=max_new_tokens)
         req.handle = RequestHandle(self, req)
         self._queue.append(req)
         self._wake.set()
@@ -243,7 +273,7 @@ class Scheduler:
                 self.metrics.inc("cancelled")
                 req.handle._push_done(Retired(
                     tokens=list(req.prompt), reason="cancelled",
-                    prompt_len=len(req.prompt)))
+                    prompt_len=req.orig_prompt_len))
                 return
         self._cancel_live.append(req)
         self._wake.set()
@@ -293,22 +323,33 @@ class Scheduler:
         wave = [self._queue.popleft() for _ in range(n)]
         wave.sort(key=lambda r: self.engine.prefill_bucket(
             min(len(r.prompt), self.engine.max_len - 1)))
-        for req in wave:
+        for i, req in enumerate(wave):
             if req.cancelled:
                 self.metrics.inc("cancelled")
                 req.handle._push_done(Retired(
                     tokens=list(req.prompt), reason="cancelled",
-                    prompt_len=len(req.prompt)))
+                    prompt_len=req.orig_prompt_len))
                 continue
-            adm = await loop.run_in_executor(
-                self._exec, self.engine.admit, req.prompt, req.max_new)
+            try:
+                adm = await loop.run_in_executor(
+                    self._exec, self.engine.admit, req.prompt, req.max_new)
+            except NoFreeBlocks:
+                # pool exhausted: the wave's remainder goes BACK to the
+                # queue head in order — they stay queued (never shed) and
+                # re-admit as retirements free blocks
+                for r in reversed(wave[i:]):
+                    self._queue.appendleft(r)
+                return
             now = time.perf_counter()
             req.seq_id = adm.seq_id
             req.admitted_at = now
             req.last_tok_at = now
             self.metrics.inc("admitted")
-            self.metrics.queue_wait.observe(now - req.submitted_at)
-            self.metrics.ttft.observe(now - req.submitted_at)
+            self.metrics.inc("prefix_hit_tokens", adm.prefix_len)
+            self.metrics.inc("prefix_miss_tokens", adm.prefilled)
+            if not req.resumed:
+                self.metrics.queue_wait.observe(now - req.submitted_at)
+                self.metrics.ttft.observe(now - req.submitted_at)
             self.metrics.inc("tokens_out")
             req.handle._push_token(adm.first_token)
             if adm.retired is not None:        # finished at prefill
@@ -320,7 +361,31 @@ class Scheduler:
         self.metrics.inc("completed")
         self.metrics.retired(ret.reason)
         self.metrics.e2e.observe(now - req.submitted_at)
+        # a resumed request's final record reports the ORIGINAL prompt
+        # length, not the resubmitted tokens-so-far prompt
+        ret.prompt_len = req.orig_prompt_len
         req.handle._push_done(ret)
+
+    def _requeue_preempted(self, req: _Request, ret: Retired) -> bool:
+        """Resubmit a preempted request at the queue head (tokens so far
+        become the prompt; remaining budget from the streamed count).
+        Returns False when the request was cancelled meanwhile — it
+        finishes as cancelled instead."""
+        if req.cancelled:
+            self.metrics.inc("cancelled")
+            self.metrics.retired("cancelled")
+            ret.reason = "cancelled"
+            ret.prompt_len = req.orig_prompt_len
+            req.handle._push_done(ret)
+            return False
+        req.prompt = list(ret.tokens)
+        req.max_new = req.budget_total - len(req.handle.tokens)
+        req.seq_id = None
+        req.admitted_at = None
+        req.resumed = True
+        self.metrics.inc("preempted")
+        self.metrics.inc("requeued")
+        return True
 
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
@@ -358,10 +423,20 @@ class Scheduler:
                     req.last_tok_at = now
                     self.metrics.inc("tokens_out")
                     req.handle._push_token(tok)
+                requeued: list[_Request] = []
                 for sid, ret in res.retired.items():
                     req = self._live.pop(sid, None)
-                    if req is not None:
+                    if req is None:
+                        continue
+                    if ret.reason == "preempted":
+                        if self._requeue_preempted(req, ret):
+                            requeued.append(req)
+                    else:
                         self._finish(req, ret, now)
+                # queue HEAD, original order: a preempted request outranks
+                # everything that arrived after it
+                for req in reversed(requeued):
+                    self._queue.appendleft(req)
                 # one cooperative yield so consumers drain between steps
                 await asyncio.sleep(0)
         except Exception as exc:               # crash guard: error, not hang

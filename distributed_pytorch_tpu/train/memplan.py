@@ -237,7 +237,8 @@ def estimate_serving_gb(model_cfg: LLMConfig, n_slots: int, max_len: int, *,
                         cache_dtype_size: int = 2,
                         quantize_weights: bool = False,
                         compute_dtype_size: int = 2,
-                        n_params: Optional[int] = None
+                        n_params: Optional[int] = None,
+                        n_slots_acts: Optional[int] = None
                         ) -> tuple[float, dict]:
     """Serving-memory estimate for one chip running the DecodeEngine:
     the bf16 serving weights (prefill always needs them), the int8 decode
@@ -258,9 +259,12 @@ def estimate_serving_gb(model_cfg: LLMConfig, n_slots: int, max_len: int, *,
     cache_b = n_slots * max_len * M.kv_bytes_per_token(
         model_cfg, cache_dtype_size, kv_scales=cache_dtype_size == 1)
     # decode activations: a few (n_slots, C) residual/qkv rows per layer
-    # plus one (n_slots, vocab) logits buffer — tiny next to the above
-    act_b = (n_slots * model_cfg.n_embd * 8 * model_cfg.n_layer * 2
-             + n_slots * model_cfg.vocab_size * 4)
+    # plus one (n_slots, vocab) logits buffer — tiny next to the above.
+    # `n_slots_acts` decouples this from the cache term so the paged
+    # block planner can price weights+acts with a zero-slot cache.
+    ns = n_slots_acts if n_slots_acts is not None else n_slots
+    act_b = (ns * model_cfg.n_embd * 8 * model_cfg.n_layer * 2
+             + ns * model_cfg.vocab_size * 4)
     breakdown = {
         "weights": weights_b / 2 ** 30,
         "quant_weights": quant_b / 2 ** 30,
@@ -271,25 +275,70 @@ def estimate_serving_gb(model_cfg: LLMConfig, n_slots: int, max_len: int, *,
     return total, {k: round(v, 3) for k, v in breakdown.items()}
 
 
+def plan_decode_blocks(model_cfg: LLMConfig, max_len: int, *,
+                       block_size: int = 16,
+                       hbm_gb: Optional[float] = None,
+                       cache_dtype_size: int = 2,
+                       quantize_weights: bool = False,
+                       n_slots_hint: Optional[int] = None,
+                       max_blocks: int = 2 ** 20) -> int:
+    """Block-budget planner for the PAGED decode engine: how many KV
+    blocks of `block_size` rows fit the per-chip HBM after the serving
+    weights (+ the int8 decode copy) and a slot-count-shaped activation
+    term. The paged pool prices MEAN sequence length instead of the slot
+    cache's worst case, so this is the number the engine's `n_blocks`
+    knob should get; `n_slots_hint` (default: pool rows / max_len, i.e.
+    worst-case sequences) only sizes the small activation estimate.
+    Returns 0 when the weights alone don't fit — the model needs
+    sharding. Closed-form + jax.eval_shape only, like plan_memory."""
+    from distributed_pytorch_tpu.train import metrics as M
+
+    budget_b = (hbm_gb if hbm_gb is not None else device_hbm_gb()) * 2 ** 30
+    n_params = param_count(model_cfg)
+    block_b = block_size * M.kv_bytes_per_token(
+        model_cfg, cache_dtype_size, kv_scales=cache_dtype_size == 1)
+
+    def fits(n_blocks: int) -> bool:
+        slots = n_slots_hint or max(1, n_blocks * block_size // max_len)
+        est, _ = estimate_serving_gb(
+            model_cfg, 0, max_len, cache_dtype_size=cache_dtype_size,
+            quantize_weights=quantize_weights, n_params=n_params,
+            n_slots_acts=slots)
+        return est * 2 ** 30 + block_b * n_blocks * _FUDGE <= budget_b
+
+    if not fits(1):
+        return 0
+    lo, hi = 1, 2
+    while hi <= max_blocks and fits(hi):
+        lo, hi = hi, hi * 2
+    hi = min(hi, max_blocks)
+    while lo + 1 < hi:                      # bisect the last doubling
+        mid = (lo + hi + 1) // 2
+        lo, hi = (mid, hi) if fits(mid) else (lo, mid)
+    return lo
+
+
 def plan_decode_slots(model_cfg: LLMConfig, max_len: int, *,
                       hbm_gb: Optional[float] = None,
                       cache_dtype_size: int = 2,
                       quantize_weights: bool = False,
+                      block_size: int = 16,
                       max_slots: int = 4096) -> int:
-    """Largest power-of-two slot count whose serving estimate fits the
-    per-chip HBM budget (0 when even one slot doesn't fit — the model
-    needs sharding). int8 knobs roughly double the answer: that is the
-    whole point of the quantized serving path."""
-    budget = hbm_gb if hbm_gb is not None else device_hbm_gb()
-    n_params = param_count(model_cfg)
+    """Largest power-of-two count of WORST-CASE (max_len) sequences the
+    block budget covers (0 when even one doesn't fit — the model needs
+    sharding). Since the paged rewrite this derives from
+    `plan_decode_blocks`: slots x (max_len / block_size) blocks is the
+    slot-cache-equivalent pool the engine defaults to; real traffic with
+    shorter/shared sequences packs more concurrency into the same pool.
+    int8 knobs roughly double the answer — the point of quantized
+    serving."""
+    n_blocks = plan_decode_blocks(
+        model_cfg, max_len, block_size=block_size, hbm_gb=hbm_gb,
+        cache_dtype_size=cache_dtype_size, quantize_weights=quantize_weights)
+    per_seq = max_len // block_size
     best = 0
     n = 1
-    while n <= max_slots:
-        est, _ = estimate_serving_gb(
-            model_cfg, n, max_len, cache_dtype_size=cache_dtype_size,
-            quantize_weights=quantize_weights, n_params=n_params)
-        if est > budget:
-            break
+    while n <= max_slots and n * per_seq <= n_blocks:
         best = n
         n *= 2
     return best

@@ -1,0 +1,175 @@
+"""Block pool allocator (ops/block_pool.py) + the paged engine's
+lifecycle over it: pool exhaustion, refcount release on cancel/EOS,
+copy-on-write fork correctness (shared prefix blocks stay immutable while
+forks diverge), and LRU eviction of unreferenced prefix blocks."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_pytorch_tpu.config import LLMConfig
+from distributed_pytorch_tpu.engine import DecodeEngine, NoFreeBlocks
+from distributed_pytorch_tpu.models.generate import generate
+from distributed_pytorch_tpu.models.gpt import LLM
+from distributed_pytorch_tpu.ops.block_pool import BlockPool, chain_keys
+
+
+# ----------------------------------------------------------------------
+# host-side allocator unit tests (no device work)
+# ----------------------------------------------------------------------
+
+def test_pool_exhaustion_and_all_or_nothing_alloc():
+    pool = BlockPool(5, 8)                   # null + 4 allocatable
+    got = [pool.alloc() for _ in range(4)]
+    assert sorted(got) == [1, 2, 3, 4]       # block 0 reserved (null)
+    assert pool.alloc() is None              # exhausted, all referenced
+    assert pool.alloc_many(1) is None
+    pool.release(got[0])
+    # all-or-nothing: asking for 2 with 1 free must not leak the 1
+    assert pool.alloc_many(2) is None
+    assert pool.n_free == 1
+    assert pool.alloc_many(1) == [got[0]]
+
+
+def test_refcounted_sharing_and_release_order():
+    pool = BlockPool(6, 8)
+    a = pool.alloc()
+    pool.register(a, ("k",))
+    pool.ref(a)                              # second sequence shares it
+    pool.release(a)
+    assert pool.n_referenced == 1            # still held by the first
+    assert pool.n_cached == 0
+    pool.release(a)
+    assert pool.n_cached == 1                # registered -> LRU, not freed
+    assert pool.lookup(("k",)) == a
+    b = pool.alloc()                         # free list first
+    assert b != a and pool.lookup(("k",)) == a
+
+
+def test_lru_eviction_of_unreferenced_prefix_blocks():
+    pool = BlockPool(4, 8)                   # 3 allocatable
+    blocks = pool.alloc_many(3)
+    for i, blk in enumerate(blocks):
+        pool.register(blk, ("key", i))
+    pool.release_all(blocks)                 # tail-first: LRU order 2,1,0
+    assert pool.n_cached == 3 and pool.n_free == 0
+    fresh = pool.alloc()                     # must evict the LRU entry
+    assert fresh == blocks[2]                # deepest block evicted first
+    assert pool.lookup(("key", 2)) is None   # its key is gone
+    assert pool.lookup(("key", 0)) == blocks[0]
+    assert pool.n_evicted == 1
+
+
+def test_chain_keys_are_prefix_sensitive():
+    a = chain_keys([1, 2, 3, 4], 2, 2)
+    b = chain_keys([9, 9, 3, 4], 2, 2)
+    assert a[0] != b[0]
+    # same block content, different ancestry -> different key (a radix
+    # path, not a flat content hash)
+    assert a[1] != b[1]
+    assert chain_keys([1, 2, 3, 4], 2, 2) == a
+
+
+# ----------------------------------------------------------------------
+# engine lifecycle over the pool
+# ----------------------------------------------------------------------
+
+def tiny_cfg(**kw):
+    base = dict(vocab_size=97, block_size=64, n_embd=48, n_head=4,
+                n_kv_heads=2, attn="gqa", n_layer=2, up_dim=64,
+                non_linearity="swiglu", pos_emb="rope", dropout=0.0)
+    base.update(kw)
+    return LLMConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def mv():
+    cfg = tiny_cfg()
+    model = LLM(cfg, attn_impl="naive")
+    rng = jax.random.PRNGKey(0)
+    x = jnp.zeros((1, cfg.block_size), jnp.int32)
+    return cfg, model, dict(model.init({"params": rng, "dropout": rng},
+                                       x, x))
+
+
+def test_release_on_cancel_and_eos(mv):
+    """Cancelling (or finishing) a sequence releases its refs: the blocks
+    become cached prefix blocks (registered full ones) or free blocks
+    (the partial tail) — the pool never leaks."""
+    _, model, variables = mv
+    eng = DecodeEngine(model, variables, n_slots=2, temperature=0.0,
+                       min_bucket=8)
+    pool = eng.block_pool
+    adm = eng.admit(list(range(1, 20)), 50)   # 19 tokens: 2 full blocks
+    assert pool.n_referenced > 0
+    eng.cancel(adm.seq_id)
+    assert pool.n_referenced == 0
+    assert pool.n_cached == 2                 # full blocks published
+    assert pool.n_free == pool.capacity - 2
+    # EOS retirement releases the same way
+    ref = generate(model, variables, jnp.asarray([[40, 41, 42]], jnp.int32),
+                   5, temperature=0.0)[0].tolist()
+    eng2 = DecodeEngine(model, variables, n_slots=1, temperature=0.0,
+                        min_bucket=8, eos_id=ref[3])
+    eng2.run([[40, 41, 42]], max_new_tokens=50)
+    assert eng2.retire_counts["eos"] == 1
+    assert eng2.block_pool.n_referenced == 0
+
+
+def test_cow_fork_shares_prefix_and_diverges(mv):
+    """Two live sequences sharing a cached prompt prefix reference the
+    SAME physical blocks; their divergent tails are private (copy-on-
+    write at block granularity), so both decode bit-identically to the
+    one-shot oracle."""
+    _, model, variables = mv
+    eng = DecodeEngine(model, variables, n_slots=2, temperature=0.0,
+                       min_bucket=8)
+    shared = list(range(1, 25))               # 24 tokens = 3 full 8-blocks
+    p1, p2 = shared + [30, 31], shared + [40, 41, 42]
+    a1 = eng.admit(p1, 8)
+    a2 = eng.admit(p2, 8)
+    assert a1.prefix_len == 0 and a1.prefilled == len(p1)
+    assert a2.prefix_len == 24 and a2.prefilled == len(p2) - 24
+    s1, s2 = eng._slots.values()
+    assert s1.blocks[:3] == s2.blocks[:3]     # physically shared prefix
+    assert set(s1.blocks[3:]).isdisjoint(s2.blocks[3:])  # private tails
+    outs = {a1.seq_id: list(p1) + [a1.first_token],
+            a2.seq_id: list(p2) + [a2.first_token]}
+    done = {}
+    while eng.n_live:
+        res = eng.step()
+        for sid, t in res.emitted.items():
+            outs[sid].append(t)
+        done.update(res.retired)
+    for p, sid in ((p1, a1.seq_id), (p2, a2.seq_id)):
+        ref = generate(model, variables, jnp.asarray(p, jnp.int32)[None], 8,
+                       temperature=0.0)[0].tolist()
+        assert done[sid].tokens == ref, "fork diverged from the oracle"
+    assert eng.prefix_hit_rate > 0.4
+
+
+def test_admit_rolls_back_prefix_refs_on_pool_exhaustion(mv):
+    """An admission that matches cached blocks but cannot allocate its
+    suffix must release the prefix refs it took (no leak) and raise
+    NoFreeBlocks — the scheduler keeps such a request queued."""
+    _, model, variables = mv
+    eng = DecodeEngine(model, variables, n_slots=3, temperature=0.0,
+                       min_bucket=8, n_blocks=9)    # capacity 8 blocks
+    shared = list(range(1, 25))                     # 3 full 8-blocks
+    a = eng.admit(shared, 60)                       # bucket 32 -> 4 blocks
+    b = eng.admit([90, 91, 92, 93, 94, 95, 96, 80, 81, 82], 60)  # 2 blocks
+    pool = eng.block_pool
+    before = pool.n_referenced
+    assert before == 6
+    # shares the 3-block prefix (refs taken) but its 20-token suffix
+    # bucket needs 4 blocks with only 3 left -> all-or-nothing rollback
+    with pytest.raises(NoFreeBlocks):
+        eng.admit(shared + list(range(30, 50)), 4)
+    assert pool.n_referenced == before              # refs rolled back
+    assert sorted(eng.live_seq_ids) == sorted([a.seq_id, b.seq_id])
+    # after a retirement frees blocks, the queued-equivalent admit works
+    eng.set_budget(a.seq_id, 1)
+    eng.step()
+    assert eng.n_live == 1
+    adm = eng.admit(shared + list(range(30, 46)), 2)
+    assert adm.prefix_len == 24                     # resumed from the LRU

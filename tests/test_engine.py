@@ -143,6 +143,88 @@ def test_engine_tp_mesh_sharded_cache():
     assert outs == refs
 
 
+@pytest.mark.parametrize("kw", [
+    dict(attn="gqa", n_kv_heads=2, pos_emb="rope"),
+    dict(attn="mla", pos_emb="rope"),
+], ids=["gqa-rope", "mla-rope"])
+def test_prefix_reuse_bit_identical(kw):
+    """Prompts sharing a block-aligned prefix admit with a prefix-cache
+    hit (only the suffix prefills) and still decode bit-identically to
+    the one-shot oracle — shared blocks are immutable, positions line
+    up, and the traced prefix length adds no prefill traces."""
+    cfg = tiny_cfg(**kw)
+    model, variables = build(cfg)
+    eng = DecodeEngine(model, variables, n_slots=2, temperature=0.0,
+                       min_bucket=8)
+    shared = list(range(1, 25))                  # 3 full 8-blocks
+    prompts = [shared + [30, 31], shared + [40], shared + [50, 51, 52]]
+    outs = eng.run(prompts, max_new_tokens=6)
+    for p, o in zip(prompts, outs):
+        ref = generate(model, variables, jnp.asarray(p, jnp.int32)[None], 6,
+                       temperature=0.0)[0].tolist()
+        assert o == ref, f"prefix-reuse diverged for prompt {p}"
+    # followers 2 and 3 hit the 24-token prefix
+    assert eng.prefix_hit_tokens == 2 * 24
+    assert eng.prefilled_tokens < sum(len(p) for p in prompts)
+    assert eng.prefix_hit_rate > 0.5
+    # reuse rides the SAME bucket traces (prefix length is traced)
+    assert eng.step_traces == 1
+
+
+def test_prefix_cache_off_is_the_baseline():
+    cfg = tiny_cfg()
+    model, variables = build(cfg)
+    eng = DecodeEngine(model, variables, n_slots=2, temperature=0.0,
+                       min_bucket=8, prefix_cache=False)
+    shared = list(range(1, 25))
+    prompts = [shared + [30, 31], shared + [40]]
+    outs = eng.run(prompts, max_new_tokens=4)
+    assert eng.prefix_hit_tokens == 0
+    assert eng.prefilled_tokens == sum(len(p) for p in prompts)
+    ref_eng = DecodeEngine(model, variables, n_slots=2, temperature=0.0,
+                           min_bucket=8)
+    assert outs == ref_eng.run(prompts, max_new_tokens=4)
+
+
+def test_preemption_requeues_and_stays_bit_identical():
+    """A pool too small for every live sequence's full output preempts
+    the youngest mid-decode; run() requeues it (tokens so far become the
+    prompt, retained blocks give a prefix hit) and the final outputs are
+    STILL bit-identical to the oracle — preemption must be invisible in
+    the tokens."""
+    cfg = tiny_cfg()
+    model, variables = build(cfg)
+    # bs=8, max_len=64 -> 8 blocks/seq worst case; capacity 11 blocks
+    # cannot hold two 6-block sequences once both grow
+    eng = DecodeEngine(model, variables, n_slots=2, temperature=0.0,
+                       min_bucket=8, n_blocks=12)
+    prompts = [[1, 2, 3], [5, 6, 7, 8, 9, 10, 11]]
+    outs = eng.run(prompts, max_new_tokens=40)
+    assert eng.retire_counts["preempted"] >= 1, \
+        "pool was sized to force preemption"
+    for p, o in zip(prompts, outs):
+        ref = generate(model, variables, jnp.asarray(p, jnp.int32)[None],
+                       40, temperature=0.0)[0].tolist()
+        assert o == ref, "preemption/resume changed the output"
+    assert eng.block_pool.n_referenced == 0      # nothing leaked
+
+
+def test_engine_paged_kernel_matches_naive(monkeypatch):
+    """FLASH_DECODE=on drives the fused step through the PAGED kernel
+    (interpret off-TPU) — tokens must match the FLASH_DECODE=off
+    gather+naive engine exactly."""
+    cfg = tiny_cfg()
+    model, variables = build(cfg)
+    monkeypatch.setenv("FLASH_DECODE", "off")
+    ref_eng = DecodeEngine(model, variables, n_slots=2, temperature=0.0,
+                           min_bucket=8)
+    refs = ref_eng.run(PROMPTS[:3], max_new_tokens=5)
+    monkeypatch.setenv("FLASH_DECODE", "on")
+    eng = DecodeEngine(model, variables, n_slots=2, temperature=0.0,
+                       min_bucket=8)
+    assert eng.run(PROMPTS[:3], max_new_tokens=5) == refs
+
+
 def test_engine_fsdp_mesh_runs():
     """fsdp recipe: params sharded over 'data', slot axis of the cache
     sharded over 'data' (2 slots x dp2)."""

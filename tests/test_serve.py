@@ -241,6 +241,47 @@ def test_stop_sheds_queued_and_cancels_live(mv):
 
 
 # ----------------------------------------------------------------------
+# block-level preemption: requeued, never shed
+# ----------------------------------------------------------------------
+
+def test_preempted_requests_requeue_not_shed(mv):
+    """With a block pool too small for every live sequence's full output,
+    the engine preempts mid-decode — the scheduler must resubmit the
+    victim at the queue head and every request must still deliver its
+    full budget: zero requests lost, zero shed."""
+
+    async def main():
+        # capacity 11 blocks; two 48-row sequences need 6 blocks each
+        eng = make_engine(mv, n_slots=2, n_blocks=12)
+        sched = Scheduler(eng, max_queue=16)
+        await sched.start()
+        handles = [sched.submit([i + 1, i + 2, i + 3], 45) for i in range(2)]
+        await asyncio.gather(*(h.result() for h in handles))
+        await sched.stop()
+        return eng, sched, handles
+
+    eng, sched, handles = run_async(main())
+    assert eng.retire_counts["preempted"] >= 1, \
+        "pool was sized to force preemption"
+    m = sched.metrics
+    assert m.counters["preempted"] == m.counters["requeued"] >= 1
+    assert m.counters["shed"] == 0
+    assert m.counters["completed"] == len(handles)
+    for h in handles:
+        assert h.retired.reason == "budget"
+        assert len(h.tokens) == 45            # the full budget, seamless
+        assert h.retired.prompt_len == 3      # original prompt, not resume
+        assert h.retired.tokens[:3] == h.retired.tokens[:3]
+        assert h.retired.tokens[3:] == h.tokens
+    # preemption resumes hit the prefix cache (retained blocks)
+    assert eng.prefix_hit_tokens > 0
+    # gauges are exported through the bench summary
+    s = m.summary()
+    assert "serve_block_utilization" in s["gauges"]
+    assert "serve_prefix_hit_rate" in s["gauges"]
+
+
+# ----------------------------------------------------------------------
 # stream parity with the offline engine
 # ----------------------------------------------------------------------
 

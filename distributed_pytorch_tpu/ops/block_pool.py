@@ -30,10 +30,12 @@ Three layers, smallest first:
   `alloc()` returning None (everything referenced) is the engine's
   preemption trigger.
 * **Prefix index** (`lookup`/`register`): content-addressed full blocks
-  keyed by the CHAIN (parent_key, block_tokens) — a flattened radix tree:
-  looking up a prompt walks key-by-key from the root, so a hit at depth d
-  proves the whole d-block prefix matches and an evicted ancestor
-  automatically unreaches its descendants (they age out of the LRU).
+  keyed by the CHAIN (parent_digest, block_tokens) — a flattened radix
+  tree: the parent's ancestry is folded into a fixed-size digest (so a
+  key hashes in O(block_size), not O(prefix)); looking up a prompt walks
+  key-by-key from the root, so a hit at depth d proves the whole d-block
+  prefix matches and an evicted ancestor automatically unreaches its
+  descendants (they age out of the LRU).
   Only FULL blocks are ever registered; the partial tail of a sequence is
   always private — sharing is copy-on-write at block granularity (a fork
   allocates a fresh tail block instead of appending to a shared one).
@@ -45,10 +47,12 @@ allocator is bookkeeping, never a device sync.
 from __future__ import annotations
 
 import collections
+import hashlib
 from typing import Iterable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 #: physical block 0 is never allocated: zeroed table rows route dead-slot
 #: writes here (see module docstring)
@@ -113,20 +117,31 @@ def paged_gather(pool: jnp.ndarray, block_tables: jnp.ndarray) -> jnp.ndarray:
 # host-side allocator + prefix index
 # ---------------------------------------------------------------------------
 
-#: chain key of the empty prefix (the radix root)
-ROOT_KEY = ()
+#: ancestry digest of the empty prefix (the radix root)
+ROOT_DIGEST = b"\x00" * 16
+
+
+def _child_digest(parent: bytes, block: tuple) -> bytes:
+    h = hashlib.blake2b(parent, digest_size=16)
+    h.update(np.asarray(block, np.int64).tobytes())
+    return h.digest()
 
 
 def chain_keys(tokens, block_size: int, n_blocks: int,
-               parent=ROOT_KEY) -> list:
+               parent=ROOT_DIGEST) -> list:
     """Chain keys for the first `n_blocks` FULL blocks of `tokens`:
-    key_i = (key_{i-1}, tokens of block i). A key encodes the whole
-    prefix up to and including its block, so equal keys imply equal
-    content at equal positions."""
+    key_i = (digest_{i-1}, tokens of block i), where digest_i folds
+    block i into its parent's digest. The digest stands in for the whole
+    ancestry, so a key encodes the prefix up to and including its block
+    (equal keys imply equal content at equal positions, up to blake2b
+    collisions) while hashing in O(block_size) — the naive nested-tuple
+    key made one admission's lookup+register pass O(n^2 * block_size)
+    host-side for an n-block prompt."""
     keys = []
     for i in range(n_blocks):
-        parent = (parent, tuple(tokens[i * block_size:(i + 1) * block_size]))
-        keys.append(parent)
+        block = tuple(int(t) for t in tokens[i * block_size:(i + 1) * block_size])
+        keys.append((parent, block))
+        parent = _child_digest(parent, block)
     return keys
 
 

@@ -11,7 +11,8 @@ module gives the host side:
   silent drop or an unbounded queue. Per-request `deadline_s` bounds the
   QUEUE WAIT: a request that can't reach a slot in time is shed with a
   'deadline' cause instead of burning a slot on an answer nobody is
-  waiting for.
+  waiting for. Only the FIRST admission is deadline-bound — a
+  preemption-requeued request is already streaming and is never shed.
 * **Bucket-grouped admission waves**: each scheduling pass fills every
   free slot from the queue head (FCFS — a stream of short requests can
   never starve an earlier long one, the property tests/test_serve.py
@@ -81,10 +82,14 @@ class _Request:
     cancelled: bool = False
     # preemption-resume bookkeeping: the caller-visible prompt length and
     # total budget never change; `resumed` marks re-admissions (their
-    # queue wait is not a TTFT)
+    # queue wait is not a TTFT, and they are exempt from deadline shed —
+    # their tokens are already streaming). `served` counts tokens PUSHED
+    # to the handle — the scheduler-paced generated count; handle.tokens
+    # is consumer-paced and lags it, so budgets must never read that.
     orig_prompt_len: int = 0
     budget_total: int = 0
     resumed: bool = False
+    served: int = 0
 
 
 class RequestHandle:
@@ -107,6 +112,7 @@ class RequestHandle:
 
     # -- scheduler side -------------------------------------------------
     def _push_token(self, tok: int) -> None:
+        self._req.served += 1
         self._events.put_nowait(("token", tok))
 
     def _push_done(self, ret: Retired) -> None:
@@ -259,6 +265,17 @@ class Scheduler:
     # internals (background loop)
     # ------------------------------------------------------------------
 
+    @staticmethod
+    def _caller_prompt_len(req: _Request, tokens: list) -> int:
+        """Index in `tokens` where GENERATED output starts. The engine
+        truncates prompts (and resume re-prompts) to their last max_len-1
+        tokens, always keeping a SUFFIX — so the last `served` entries of
+        `tokens` are generated and everything before them is prompt.
+        `orig_prompt_len` over-counts whenever truncation dropped prompt
+        tokens; this never does (== orig_prompt_len when nothing was
+        dropped)."""
+        return max(0, len(tokens) - req.served)
+
     def _request_cancel(self, req: _Request) -> None:
         if req.cancelled or req.handle.retired is not None \
                 or req.handle.error is not None:
@@ -273,7 +290,7 @@ class Scheduler:
                 self.metrics.inc("cancelled")
                 req.handle._push_done(Retired(
                     tokens=list(req.prompt), reason="cancelled",
-                    prompt_len=req.orig_prompt_len))
+                    prompt_len=self._caller_prompt_len(req, req.prompt)))
                 return
         self._cancel_live.append(req)
         self._wake.set()
@@ -298,11 +315,14 @@ class Scheduler:
                              and r.handle.error is None]
 
     def _shed_expired(self, now: float) -> None:
-        """Evict queued requests whose deadline passed (never a live one —
-        its tokens are already streaming)."""
+        """Evict queued requests whose deadline passed — never a live one
+        (its tokens are already streaming) and never a preemption-requeued
+        one (same reason: the client already holds part of the stream, so
+        a shed here would be user-visible loss; the deadline only bounds
+        the wait for the FIRST token)."""
         keep: collections.deque[_Request] = collections.deque()
         for req in self._queue:
-            if req.deadline_s is not None \
+            if not req.resumed and req.deadline_s is not None \
                     and now - req.submitted_at > req.deadline_s:
                 self.metrics.shed("deadline")
                 req.handle._push_error(ShedError(
@@ -328,7 +348,7 @@ class Scheduler:
                 self.metrics.inc("cancelled")
                 req.handle._push_done(Retired(
                     tokens=list(req.prompt), reason="cancelled",
-                    prompt_len=req.orig_prompt_len))
+                    prompt_len=self._caller_prompt_len(req, req.prompt)))
                 continue
             try:
                 adm = await loop.run_in_executor(
@@ -361,25 +381,30 @@ class Scheduler:
         self.metrics.inc("completed")
         self.metrics.retired(ret.reason)
         self.metrics.e2e.observe(now - req.submitted_at)
-        # a resumed request's final record reports the ORIGINAL prompt
-        # length, not the resubmitted tokens-so-far prompt
-        ret.prompt_len = req.orig_prompt_len
+        # a resumed request's final record reports the caller-visible
+        # prompt boundary, not the resubmitted tokens-so-far prompt
+        ret.prompt_len = self._caller_prompt_len(req, ret.tokens)
         req.handle._push_done(ret)
 
     def _requeue_preempted(self, req: _Request, ret: Retired) -> bool:
         """Resubmit a preempted request at the queue head (tokens so far
-        become the prompt; remaining budget from the streamed count).
-        Returns False when the request was cancelled meanwhile — it
-        finishes as cancelled instead."""
+        become the prompt; remaining budget from the scheduler-side
+        `served` count — handle.tokens is consumer-paced and lags, which
+        would over-budget the resume and double-emit tokens). Returns
+        False when the request was cancelled meanwhile — it finishes as
+        cancelled instead."""
         if req.cancelled:
             self.metrics.inc("cancelled")
             self.metrics.retired("cancelled")
             ret.reason = "cancelled"
-            ret.prompt_len = req.orig_prompt_len
+            ret.prompt_len = self._caller_prompt_len(req, ret.tokens)
             req.handle._push_done(ret)
             return False
         req.prompt = list(ret.tokens)
-        req.max_new = req.budget_total - len(req.handle.tokens)
+        # served < budget_total always holds here: the engine retires on
+        # 'budget' (not 'preempted') the step the budget is reached
+        req.max_new = req.budget_total - req.served
+        assert req.max_new >= 1, "preempted past its budget"
         req.seq_id = None
         req.admitted_at = None
         req.resumed = True

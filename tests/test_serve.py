@@ -281,6 +281,61 @@ def test_preempted_requests_requeue_not_shed(mv):
     assert "serve_prefix_hit_rate" in s["gauges"]
 
 
+def test_preemption_budget_ignores_consumer_lag(mv):
+    """The resume budget must come from the scheduler-side served count,
+    not the consumer-paced handle.tokens: a client that hasn't drained a
+    single token when preemption lands must still receive EXACTLY its
+    budget (no re-generated duplicates, no over-emission, no crash from a
+    <=0 resume budget after repeated preemptions)."""
+
+    async def main():
+        eng = make_engine(mv, n_slots=2, n_blocks=12)
+        sched = Scheduler(eng, max_queue=16)
+        await sched.start()
+        handles = [sched.submit([i + 1, i + 2, i + 3], 45) for i in range(2)]
+        # do NOT drain: wait for retirement with the streams untouched,
+        # so handle.tokens stays empty through every preemption/resume
+        while any(h.retired is None for h in handles):
+            await asyncio.sleep(0.01)
+        assert all(len(h.tokens) == 0 for h in handles)  # truly undrained
+        await asyncio.gather(*(h.result() for h in handles))
+        await sched.stop()
+        return eng, sched, handles
+
+    eng, sched, handles = run_async(main())
+    assert eng.retire_counts["preempted"] >= 1, \
+        "pool was sized to force preemption"
+    assert sched.metrics.counters["shed"] == 0
+    for h in handles:
+        assert h.retired.reason == "budget"
+        assert len(h.tokens) == 45            # exactly the budget
+        assert h.retired.prompt_len == 3
+        assert h.retired.tokens[3:] == h.tokens
+
+
+def test_truncated_prompt_reports_kept_prompt_len(mv):
+    """A prompt >= max_len is truncated by the engine to its last
+    max_len-1 tokens; the final record's prompt_len must point at the
+    generated-output boundary WITHIN ret.tokens (slicing
+    tokens[prompt_len:] yields exactly the generated stream), not the
+    untruncated submitted length."""
+
+    async def main():
+        eng = make_engine(mv, n_slots=1)          # max_len = block_size = 64
+        sched = Scheduler(eng, max_queue=4)
+        await sched.start()
+        h = sched.submit(list(range(1, 71)), 2)   # 70 tokens > max_len
+        ret = await h.result()
+        await sched.stop()
+        return h, ret
+
+    h, ret = run_async(main())
+    assert ret.reason == "budget"
+    assert ret.prompt_len == 63                   # the kept suffix
+    assert len(ret.tokens) == 63 + 2
+    assert ret.tokens[ret.prompt_len:] == h.tokens
+
+
 # ----------------------------------------------------------------------
 # stream parity with the offline engine
 # ----------------------------------------------------------------------

@@ -41,6 +41,26 @@ thousands of requests share a system prompt:
   traces), every live slot advances in a single jitted step traced once,
   and under a mesh the pools shard kv heads over 'model' and blocks over
   'data' via `sharding.decode_cache_pspec`.
+* **Chunked prefill fused into the decode step** (`prefill_chunk=N`,
+  round 12 — Sarathi-style): instead of one monolithic bucket prefill
+  per admission that stalls every live decode stream, each admitted
+  prompt is split into <=N-token chunks and ONE chunk rides each fused
+  step next to all live decode tokens, in a single jitted program
+  (`_get_fused_step_fn`). The chunk buffer is a fixed (1, N) trace; the
+  slot, write offset, and valid length are TRACED arguments — no new
+  traces per prompt length, and the pow2 buckets retire to a chunk-size
+  pad. Decode tokens get strict priority: the per-step prefill take is
+  the chunk budget minus the live decode count (floored at one block so
+  prefill can't starve), rounded down to a whole number of blocks so
+  every chunk writes at a block-aligned offset. While a slot prefills it
+  is PARKED: live=False (token frozen) and its device position points at
+  the always-empty last table column, so the fused decode write lands in
+  the null block, never in its real cache. Per-slot prefill progress
+  (`_Slot.suffix_done`) composes with everything else: a mid-prefill
+  preemption retires the partial with its already-written full blocks
+  registered in the radix index, so the requeued resume re-admits with a
+  prefix hit and only the tail left to chunk in. `prefill_chunk=0` keeps
+  the legacy all-or-nothing wave path (the A/B baseline).
 
 Host/device split as before: sampling, cache writes, and positions are
 device-side; the allocator, radix index, and retirement logic are plain
@@ -85,13 +105,15 @@ class Retired:
 @dataclasses.dataclass
 class Admission:
     """What `admit()` hands back: the sequence id, the first sampled token
-    (prefill samples it — a streaming caller's TTFT token), prefix-cache
-    accounting (`prefix_len` reused tokens, `prefilled` suffix tokens
-    actually computed), and, for a request that finished AT prefill
-    (1-token budget, instant EOS), its `Retired` record."""
+    (prefill samples it — a streaming caller's TTFT token; None in
+    chunked-prefill mode, where the first token arrives from the fused
+    step that runs the prompt's LAST chunk), prefix-cache accounting
+    (`prefix_len` reused tokens, `prefilled` suffix tokens to compute),
+    and, for a request that finished AT prefill (1-token budget, instant
+    EOS — wave mode only), its `Retired` record."""
 
     seq_id: int
-    first_token: int
+    first_token: Optional[int]
     retired: Optional[Retired] = None
     prefix_len: int = 0
     prefilled: int = 0
@@ -100,12 +122,17 @@ class Admission:
 @dataclasses.dataclass
 class StepResult:
     """One fused step's host-visible output: `emitted` maps every sequence
-    that advanced this step to the token it sampled; `retired` holds the
-    sequences that finished — including any preempted BEFORE the step ran
-    (those emit no token)."""
+    that advanced this step to the token it sampled — including a
+    sequence whose final prefill chunk ran this step (its entry is the
+    first sampled token); `retired` holds the sequences that finished,
+    including any preempted BEFORE the step ran (those emit no token).
+    `prefill_tokens` is the chunk work fused into this step (0 on pure
+    decode steps and in wave mode) — the scheduler feeds it to the
+    `prefill_tokens_per_step` histogram."""
 
     emitted: dict
     retired: dict
+    prefill_tokens: int = 0
 
 
 @dataclasses.dataclass
@@ -118,8 +145,16 @@ class _Slot:
     n_new: int            # generated tokens recorded so far
     max_new: int
     pos: int              # device pos mirror: next cache write position
+                          # (for a partial slot: prefill rows written)
     blocks: list          # owned physical block ids, logical order
     order: int            # admission counter (preemption picks the max)
+    # chunked-prefill progress (prefill_chunk > 0): the suffix left to
+    # compute after the prefix-cache hit, and how much of it has been
+    # chunked into the cache so far. suffix_done < len(suffix) marks the
+    # slot PARTIAL: parked out of the decode batch until its last chunk.
+    suffix: Optional[list] = None
+    suffix_done: int = 0
+    prefix_len: int = 0
 
 
 class DecodeEngine:
@@ -137,6 +172,14 @@ class DecodeEngine:
     n_slots x max_len footprint, i.e. never preempts under slot-cache
     load; smaller pools trade preemption for HBM), `prefix_cache=False`
     disables content-addressed reuse (the A/B baseline).
+
+    `prefill_chunk=N` fuses Sarathi-style chunked prefill into the step
+    (module docstring): each fused step runs <=N prefill tokens of the
+    oldest partial prompt plus all live decode tokens in ONE trace —
+    bounded ITL under prefill-heavy load. N must be a multiple of
+    `block_size`; pick N >= n_slots + block_size so decode priority
+    leaves the prefill budget at least one block. 0 (default) keeps the
+    all-or-nothing bucketed wave prefill (the A/B baseline).
 
     Quantized serving (ops/quant.py) is unchanged: `cache_dtype='int8'`
     quantizes on the block write (scale sidecars ride pool-shaped
@@ -156,7 +199,8 @@ class DecodeEngine:
                  mesh=None, recipe: str = "single", min_bucket: int = 16,
                  block_size: Optional[int] = None,
                  n_blocks: Optional[int] = None,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 prefill_chunk: int = 0):
         cfg = model.config
         self.model = model
         self.cfg = cfg
@@ -207,6 +251,28 @@ class DecodeEngine:
         self.block_pool = BlockPool(n_blocks, bs)
         self.prefix_cache = prefix_cache
 
+        # chunked prefill (module docstring): the per-step prefill token
+        # budget. Chunks must be whole blocks so every chunk's write
+        # offset stays block-aligned (paged_update's prefill contract).
+        if prefill_chunk:
+            assert prefill_chunk % bs == 0 and prefill_chunk >= bs, (
+                f"prefill_chunk {prefill_chunk} must be a positive "
+                f"multiple of block_size {bs}")
+            prefill_chunk = min(prefill_chunk, self.max_len)
+        self.prefill_chunk = prefill_chunk
+        # slack table columns absorb the fixed-size chunk buffer's
+        # overhang: the last chunk of a prompt ending near max_len writes
+        # its full (block-aligned) buffer, and the rows past the prompt
+        # must slice table entries that exist AND are zero (null-block
+        # writes) — without the slack, dynamic_slice would clamp the
+        # start and corrupt earlier blocks
+        self.table_width = self.max_blocks + \
+            (prefill_chunk // bs if prefill_chunk else 0)
+        # partial slots park their decode-write position in the last
+        # table column, which is never allocated: the fused step's
+        # unavoidable write for a not-yet-live slot lands in block 0
+        self._park_pos = (self.table_width - 1) * bs
+
         if mesh is not None:
             from distributed_pytorch_tpu.parallel import sharding as shd
             from jax.sharding import NamedSharding
@@ -244,7 +310,7 @@ class DecodeEngine:
         self.live = jnp.zeros((n_slots,), bool)
         # host-mirrored block tables: rows of physical block ids per slot;
         # zeroed rows route dead-slot writes to the null block
-        self._tables_h = np.zeros((n_slots, self.max_blocks), np.int32)
+        self._tables_h = np.zeros((n_slots, self.table_width), np.int32)
         self._tables_dirty = True
         self.block_tables = None
         self._sync_tables()
@@ -257,8 +323,10 @@ class DecodeEngine:
         # unusable donations, so skip it there
         self._donate = (1,) if jax.default_backend() == "tpu" else ()
         self._step_fn = None
+        self._fused_step_fn = None
         self._admit_fns: dict[int, Any] = {}
         self.step_traces = 0                   # test hook: must stay 1
+        self.fused_step_traces = 0             # ditto for the chunked step
         self.admit_traces: dict[int, int] = {}  # bucket -> trace count
         # lifetime counters — the stable occupancy/accounting surface a
         # scheduler reads instead of poking _slots
@@ -320,6 +388,58 @@ class DecodeEngine:
         self._step_fn = jax.jit(step, donate_argnums=self._donate)
         return self._step_fn
 
+    def _get_fused_step_fn(self):
+        """The chunked-prefill step: ONE jitted program that runs <=N
+        prefill tokens of one partial prompt plus every live decode
+        token. The chunk buffer is a fixed (1, prefill_chunk) shape; the
+        target slot, block-aligned write offset, and valid length are
+        traced, so the whole serving mix shares this single trace (the
+        chunked analogue of `prefix_len` being traced in the wave admit).
+        """
+        if self._fused_step_fn is not None:
+            return self._fused_step_fn
+        n_slots, W = self.n_slots, self.table_width
+
+        def fused_step(variables, caches, tok, pos, live, bt, rng, t,
+                       qparams, ctoks, cslot, coff, clen, cdone):
+            self.fused_step_traces += 1  # python side effect: trace count
+            # chunk prefill: write [coff, coff+N) of the chunk slot's
+            # logical sequence (rows past clen are pads landing in the
+            # null block via zero table entries) and attend causally over
+            # the sequence's own prior blocks. Runs OUTSIDE the quantized
+            # store, like the wave admit — prefill stays bf16 under
+            # weight-only int8.
+            bt_row = jax.lax.dynamic_slice(
+                bt, (cslot, jnp.int32(0)), (1, W))
+            clogits, _, caches = self.model.apply(
+                variables, ctoks, None, caches, coff, deterministic=True,
+                logits_idx=clen - 1, block_tables=bt_row)
+            first = self._sample(clogits[:, -1, :],
+                                 jax.random.fold_in(rng, 2 ** 21 + t))
+            from distributed_pytorch_tpu.ops.quant import \
+                use_quantized_params
+            with use_quantized_params(qparams):
+                logits, _, caches = self.model.apply(
+                    variables, tok[:, None], None, caches, pos,
+                    deterministic=True, block_tables=bt)
+            nxt = self._sample(logits[:, -1, :], jax.random.fold_in(rng, t))
+            # dead/parked slots freeze their token; parked positions point
+            # at the null block so the decode write above was harmless
+            nxt = jnp.where(live, nxt, tok)
+            pos = pos + live.astype(jnp.int32)
+            # a chunk that completes its prompt activates the slot
+            # in-step: first sampled token + true position land exactly
+            # like a wave admit's would
+            sel = (jnp.arange(n_slots) == cslot) & cdone
+            nxt = jnp.where(sel, first[0], nxt)
+            pos = jnp.where(sel, coff + clen[0], pos)
+            live = jnp.logical_or(live, sel)
+            return caches, nxt, pos, live
+
+        self._fused_step_fn = jax.jit(fused_step,
+                                      donate_argnums=self._donate)
+        return self._fused_step_fn
+
     def _get_admit_fn(self, bucket: int):
         fn = self._admit_fns.get(bucket)
         if fn is not None:
@@ -355,6 +475,22 @@ class DecodeEngine:
     @property
     def free_slots(self) -> list[int]:
         return [s for s in range(self.n_slots) if s not in self._slots]
+
+    @staticmethod
+    def _is_partial(seq: _Slot) -> bool:
+        """A chunked admission whose prompt is not fully in the cache yet
+        — parked out of the decode batch until its last chunk runs."""
+        return seq.suffix is not None and seq.suffix_done < len(seq.suffix)
+
+    def _live_slots(self) -> list[int]:
+        """Slots decoding this step (occupied and not mid-prefill)."""
+        return [s for s, seq in self._slots.items()
+                if not self._is_partial(seq)]
+
+    def _rebuild_live(self) -> None:
+        mask = np.zeros((self.n_slots,), bool)
+        mask[self._live_slots()] = True
+        self.live = jnp.asarray(mask)
 
     @property
     def n_live(self) -> int:
@@ -488,7 +624,13 @@ class DecodeEngine:
         is free (check `free_slots`) and `NoFreeBlocks` when the pool
         cannot cover the suffix even after evicting every unreferenced
         cached block — the caller keeps the request queued and admits
-        again after a retirement."""
+        again after a retirement.
+
+        With `prefill_chunk` set, admission is bookkeeping only: the slot
+        is parked, blocks for the FIRST chunk are reserved (NoFreeBlocks
+        keeps the admission-bound contract), and the prompt is chunked
+        into subsequent fused steps — `first_token` is None and arrives
+        via `StepResult.emitted` when the last chunk runs."""
         free = self.free_slots
         assert free, "no free slot — step()/retire before admitting"
         assert max_new_tokens >= 1
@@ -499,6 +641,9 @@ class DecodeEngine:
         L = len(toks)
         bs = self.block_size
         prefix_len, matched = self._match_prefix(toks)
+        if self.prefill_chunk:
+            return self._admit_chunked(slot, toks, L, prefix_len, matched,
+                                       max_new_tokens, seq_id)
         suffix = toks[prefix_len:]
         bucket = min(self.prefill_bucket(len(suffix)),
                      self.max_len - prefix_len)
@@ -557,6 +702,93 @@ class DecodeEngine:
                          retired=retired, prefix_len=prefix_len,
                          prefilled=len(suffix))
 
+    def _admit_chunked(self, slot: int, toks: list, L: int,
+                       prefix_len: int, matched: list, max_new_tokens: int,
+                       seq_id: Optional[int]) -> Admission:
+        """Chunked-mode admission: no device call, no prefill trace. The
+        slot is parked (live=False, write position in the always-zero
+        last table column) and the suffix waits for the step loop to
+        chunk it in. Only the first chunk's blocks are reserved here —
+        `NoFreeBlocks` still means "stay queued" — the rest allocate
+        lazily per chunk, so a long prompt never holds blocks for rows it
+        hasn't written."""
+        bs = self.block_size
+        suffix = toks[prefix_len:]
+        first_rows = prefix_len + min(self.prefill_chunk, len(suffix))
+        need = -(-first_rows // bs) - len(matched)
+        # take prefix refs BEFORE allocating (alloc may evict the LRU)
+        for blk in matched:
+            self.block_pool.ref(blk)
+        new_ids = self.block_pool.alloc_many(max(need, 0))
+        if new_ids is None:
+            self.block_pool.release_all(matched)
+            raise NoFreeBlocks(
+                f"pool exhausted: {self.block_pool.n_referenced} of "
+                f"{self.block_pool.capacity} blocks referenced by "
+                f"{self.n_live} live sequences; admit after a retirement")
+        blocks = matched + new_ids
+        self._tables_h[slot, :] = 0
+        self._tables_h[slot, :len(blocks)] = blocks
+        self._tables_dirty = True
+        # park the decode write: the fused step writes every slot's row,
+        # and this slot's table row is real — point it at the null block
+        self.pos = self.pos.at[slot].set(self._park_pos)
+        if seq_id is None:
+            seq_id = self._next_id
+        self._next_id = max(self._next_id, seq_id) + 1
+        self._slots[slot] = _Slot(
+            seq_id=seq_id, tokens=list(toks), prompt_len=L, n_new=0,
+            max_new=max_new_tokens, pos=prefix_len, blocks=blocks,
+            order=self.n_admitted, suffix=suffix, suffix_done=0,
+            prefix_len=prefix_len)
+        self.n_admitted += 1
+        self.prompt_tokens += L
+        self.prefix_hit_tokens += prefix_len
+        return Admission(seq_id=seq_id, first_token=None,
+                         prefix_len=prefix_len, prefilled=len(suffix))
+
+    def _next_chunk(self, preempted: dict) -> Optional[tuple[int, int]]:
+        """Pick this step's prefill work: the OLDEST partial prompt gets
+        the leftover token budget (decode tokens have strict priority),
+        rounded down to whole blocks and floored at one block so a
+        saturated slot table can't starve prefill forever. Grows the
+        slot's block list to cover the chunk, preempting youngest-first
+        when the pool is dry (the partial itself is usually youngest —
+        then the next-oldest partial gets its turn). Returns
+        (slot, take) or None; preemption victims land in `preempted`."""
+        bs = self.block_size
+        while True:
+            partials = [(seq.order, slot) for slot, seq in
+                        self._slots.items() if self._is_partial(seq)]
+            if not partials:
+                return None
+            slot = min(partials)[1]
+            seq = self._slots[slot]
+            remaining = len(seq.suffix) - seq.suffix_done
+            avail = self.prefill_chunk - len(self._live_slots())
+            avail -= avail % bs
+            avail = min(max(avail, bs), self.prefill_chunk)
+            take = min(avail, remaining)
+            need = -(-(seq.prefix_len + seq.suffix_done + take) // bs)
+            ok = True
+            while len(seq.blocks) < need:
+                blk = self.block_pool.alloc()
+                if blk is None:
+                    victim = self._pick_victim()
+                    vseq = self._slots[victim]
+                    preempted[vseq.seq_id] = self._retire(victim,
+                                                          "preempted")
+                    self._rebuild_live()
+                    if victim == slot:
+                        ok = False
+                        break
+                    continue
+                self._tables_h[slot, len(seq.blocks)] = blk
+                seq.blocks.append(blk)
+                self._tables_dirty = True
+            if ok:
+                return slot, take
+
     def _pick_victim(self) -> int:
         """Slot of the youngest-admitted live sequence — the vLLM-style
         recompute-preemption order: the last one in has the least sunk
@@ -571,6 +803,10 @@ class DecodeEngine:
         preempted: dict[int, Retired] = {}
         for slot in sorted(self._slots):
             seq = self._slots.get(slot)
+            # partial slots don't decode-write; their growth is per-chunk
+            # (_next_chunk) so idle prefill rows never hold blocks
+            if seq is not None and self._is_partial(seq):
+                continue
             while seq is not None and \
                     seq.pos >= len(seq.blocks) * self.block_size:
                 blk = self.block_pool.alloc()
@@ -585,38 +821,87 @@ class DecodeEngine:
                 if victim == slot:
                     seq = None       # preempted itself; stop growing it
         if preempted:
-            mask = np.zeros((self.n_slots,), bool)
-            mask[list(self._slots)] = True
-            self.live = jnp.asarray(mask)
+            self._rebuild_live()
         return preempted
 
     def step(self) -> StepResult:
-        """Advance every live slot one token. Returns a `StepResult`:
-        {seq_id: token} sampled this step, plus {seq_id: Retired} for the
-        sequences that finished (with WHY — eos | budget | cache_full |
-        preempted; preempted ones yielded their blocks BEFORE the step and
-        emit no token — requeue them)."""
+        """Advance every live slot one token, fusing in one prefill chunk
+        of the oldest partial prompt when `prefill_chunk` is set. Returns
+        a `StepResult`: {seq_id: token} sampled this step (including the
+        first token of a prompt whose LAST chunk ran), plus
+        {seq_id: Retired} for the sequences that finished (with WHY —
+        eos | budget | cache_full | preempted; preempted ones yielded
+        their blocks BEFORE the step and emit no token — requeue
+        them)."""
         if not self._slots:
             return StepResult({}, {})
         preempted = self._ensure_blocks()
-        if not self._slots:
+        chunk = self._next_chunk(preempted) if self.prefill_chunk else None
+        if not self._slots or (chunk is None and not self._live_slots()):
             return StepResult({}, preempted)
         self._sync_tables()
-        with self._ctx():
-            self.caches, self.tok, self.pos = self._get_step_fn()(
-                self.variables, self.caches, self.tok, self.pos, self.live,
-                self.block_tables, self._rng, jnp.int32(self._t),
-                self._qparams)
+        chunk_done = False
+        if chunk is not None:
+            slot_c, take = chunk
+            seq_c = self._slots[slot_c]
+            off = seq_c.prefix_len + seq_c.suffix_done
+            chunk_done = seq_c.suffix_done + take == len(seq_c.suffix)
+            buf = seq_c.suffix[seq_c.suffix_done:seq_c.suffix_done + take]
+            padded = jnp.asarray(
+                buf + [0] * (self.prefill_chunk - take), jnp.int32)[None]
+            with self._ctx():
+                out = self._get_fused_step_fn()(
+                    self.variables, self.caches, self.tok, self.pos,
+                    self.live, self.block_tables, self._rng,
+                    jnp.int32(self._t), self._qparams, padded,
+                    jnp.int32(slot_c), jnp.int32(off),
+                    jnp.asarray([take], jnp.int32), jnp.bool_(chunk_done))
+            self.caches, self.tok, self.pos, self.live = out
+        else:
+            with self._ctx():
+                self.caches, self.tok, self.pos = self._get_step_fn()(
+                    self.variables, self.caches, self.tok, self.pos,
+                    self.live, self.block_tables, self._rng,
+                    jnp.int32(self._t), self._qparams)
         self._t += 1
         sampled = jax.device_get(self.tok)
         emitted: dict[int, int] = {}
         retired: dict[int, Retired] = dict(preempted)
+        prefill_tokens = 0
+        if chunk is not None:
+            # host mirror of the chunk: progress the partial, publish the
+            # blocks that just became full+immutable into the radix index
+            # (register is first-writer-wins, so re-publishing earlier
+            # ones is a no-op), and — on the final chunk — promote the
+            # slot to live with its first sampled token, exactly where a
+            # wave admit would have left it
+            prefill_tokens = take
+            seq_c.suffix_done += take
+            seq_c.pos = seq_c.prefix_len + seq_c.suffix_done
+            self.prefilled_tokens += take
+            if self.prefix_cache:
+                full = min(seq_c.pos, len(seq_c.blocks) * self.block_size) \
+                    // self.block_size
+                for key, blk in zip(chain_keys(seq_c.tokens,
+                                               self.block_size, full),
+                                    seq_c.blocks):
+                    self.block_pool.register(blk, key)
+            if chunk_done:
+                first_tok = int(sampled[slot_c])
+                seq_c.tokens.append(first_tok)
+                seq_c.n_new = 1
+                seq_c.pos = seq_c.prompt_len
         for slot in list(self._slots):
             seq = self._slots[slot]
+            if self._is_partial(seq):
+                continue                       # still parked: no token
             nxt = int(sampled[slot])
-            seq.tokens.append(nxt)
-            seq.n_new += 1
-            seq.pos += 1
+            if chunk is not None and slot == slot_c and chunk_done:
+                pass                           # bookkeeping done above
+            else:
+                seq.tokens.append(nxt)
+                seq.n_new += 1
+                seq.pos += 1
             emitted[seq.seq_id] = nxt
             reason = self._retire_reason(slot, nxt)
             if reason is not None:
@@ -624,10 +909,9 @@ class DecodeEngine:
         # drop retired slots from the live mask (their table rows are
         # zeroed, so any residual write lands in the null block)
         if len(retired) > len(preempted):
-            mask = np.zeros((self.n_slots,), bool)
-            mask[list(self._slots)] = True
-            self.live = jnp.asarray(mask)
-        return StepResult(emitted=emitted, retired=retired)
+            self._rebuild_live()
+        return StepResult(emitted=emitted, retired=retired,
+                          prefill_tokens=prefill_tokens)
 
     def run(self, prompts, max_new_tokens,
             progress=None) -> list[list]:

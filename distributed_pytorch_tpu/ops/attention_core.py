@@ -179,6 +179,23 @@ def sdpa(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                                          v_scale=v_scale,
                                          interpret=not _on_tpu())
                 return out[:, None]
+        # mixed prefill+decode path: a multi-token chunk (or a whole
+        # bucketed-wave suffix) of ONE sequence, written at q_offset and
+        # attending causally over the sequence's own prior blocks — the
+        # chunk kernel streams those blocks through the table prefetch
+        # instead of gathering the whole logical view
+        if (decode and causal and q.shape[1] > 1 and q.shape[0] == 1
+                and not use_dropout and impl in ("auto", "pallas", "xla")):
+            from distributed_pytorch_tpu.ops.flash_decode import (
+                decode_mode, paged_flash_prefill, paged_flash_prefill_usable)
+            mode = decode_mode()
+            if (mode == "on" or (mode == "auto" and _on_tpu())) \
+                    and paged_flash_prefill_usable(q, k, v, block_tables):
+                off = jnp.reshape(jnp.asarray(q_offset, jnp.int32), (-1,))[0]
+                return paged_flash_prefill(q, k, v, block_tables, off,
+                                           scale=scale, k_scale=k_scale,
+                                           v_scale=v_scale,
+                                           interpret=not _on_tpu())
         from distributed_pytorch_tpu.ops.block_pool import paged_gather
         k = paged_gather(k, block_tables)
         v = paged_gather(v, block_tables)

@@ -32,6 +32,14 @@ single-token attention):
   zero HBM traffic.
 * The last partial block masks `kpos >= cache_len` to a large negative
   (NaN-free) before the max/sum update.
+* **Chunked-prefill variant** (`paged_flash_prefill`, round 12): the
+  paged decode kernel generalized from one query row per sequence to a
+  (T, rep)-packed query tile of ONE sequence — a prefill chunk written
+  at an arbitrary block-aligned offset attends the sequence's own prior
+  blocks plus its in-chunk causal prefix, with per-row global positions
+  in the mask. This is the device half of the engine's fused
+  chunk+decode step (engine/decode.py `prefill_chunk`); bf16 and int8
+  pools ride the same block-table index map.
 
 Contract (mirrors `loss_impl='pallas'` / `grouped_usable` /
 `flash_attention_usable`): gate with `flash_decode_usable` first; callers
@@ -348,6 +356,227 @@ def paged_flash_decode(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         interpret=interpret,
     )(cl, bt, *operands)
     return out.reshape(B, nh, hs)
+
+
+def _prefill_kernel(meta_ref, bt_ref, q_ref, k_ref, v_ref, o_ref,
+                    acc_ref, m_ref, l_ref, *, scale: float, bs: int,
+                    rep: int):
+    """Chunked-prefill body: T queries of ONE sequence (packed (t, rep)
+    into the sublane dim) against its own paged blocks, causal against
+    the global positions `off + t`. Same online-softmax state as the
+    decode kernels — only the mask gains the per-row query position."""
+    j = pl.program_id(0)
+    off = meta_ref[0]
+    n_rows = q_ref.shape[1]                     # T * rep (static)
+    T = n_rows // rep
+    last_j = jax.lax.div(jnp.maximum(off + T, 1) - 1, bs)
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    @pl.when(j <= last_j)
+    def _():
+        q = q_ref[:]                            # (nkv, T*rep, hs)
+        k = k_ref[0].transpose(1, 0, 2)         # (nkv, bs, hs)
+        v = v_ref[0].transpose(1, 0, 2)
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale  # (nkv, T*rep, bs)
+        qpos = off + jax.lax.div(
+            jax.lax.broadcasted_iota(jnp.int32, s.shape, 1), rep)
+        kpos = j * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(kpos <= qpos, s, _NEG_INF)
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == pl.num_programs(0) - 1)
+    def _():
+        o_ref[:] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
+                    ).astype(o_ref.dtype)
+
+
+def _prefill_kernel_q8(meta_ref, bt_ref, q_ref, k_ref, ks_ref, v_ref,
+                       vs_ref, o_ref, acc_ref, m_ref, l_ref, *,
+                       scale: float, bs: int, rep: int):
+    """int8-pool chunked prefill: codes + per-(row, kv-head) scale rows
+    through the same block index map; dequantization folds into the
+    score/probability tiles exactly as in `_kernel_q8`."""
+    j = pl.program_id(0)
+    off = meta_ref[0]
+    n_rows = q_ref.shape[1]
+    T = n_rows // rep
+    last_j = jax.lax.div(jnp.maximum(off + T, 1) - 1, bs)
+
+    @pl.when(j == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    @pl.when(j <= last_j)
+    def _():
+        q = q_ref[:]                            # (nkv, T*rep, hs)
+        dt = q.dtype
+        k = k_ref[0].transpose(1, 0, 2).astype(dt)
+        v = v_ref[0].transpose(1, 0, 2).astype(dt)
+        ks = ks_ref[0].transpose(1, 2, 0)       # (nkv, 1, bs)
+        vs = vs_ref[0].transpose(1, 2, 0)
+        s = jax.lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        s = s * (ks * scale)
+        qpos = off + jax.lax.div(
+            jax.lax.broadcasted_iota(jnp.int32, s.shape, 1), rep)
+        kpos = j * bs + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+        s = jnp.where(kpos <= qpos, s, _NEG_INF)
+        m_prev, l_prev = m_ref[:], l_ref[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        m_ref[:] = m_new
+        l_ref[:] = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            (p * vs).astype(dt), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == pl.num_programs(0) - 1)
+    def _():
+        o_ref[:] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)
+                    ).astype(o_ref.dtype)
+
+
+def paged_flash_prefill(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                        block_tables: jnp.ndarray, q_offset, *,
+                        scale: float, k_scale: jnp.ndarray = None,
+                        v_scale: jnp.ndarray = None,
+                        interpret: bool = False) -> jnp.ndarray:
+    """Mixed-path chunk attention over a PAGED cache: q (1, T, nh, hs) —
+    a prefill chunk of ONE sequence whose rows sit at global positions
+    [q_offset, q_offset+T) — against the (n_blocks, bs, n_kv, hs) pool,
+    addressed through the sequence's block table (1, max_blocks) int32.
+    The chunk's rows must already be written to the pool (the attention
+    path writes before it reads, exactly like the wave prefill). Returns
+    (1, T, nh, hs).
+
+    This is `paged_flash_decode` generalized from one query row to a
+    (t, rep)-packed query tile: the grid still walks logical blocks with
+    the prefetched table resolving physical ids, steps past the chunk's
+    last needed block clamp to it (no DMA), and the causal mask compares
+    each row's global position `q_offset + t` against the block's key
+    positions — so a chunk at an arbitrary block-aligned offset attends
+    the sequence's own prior blocks and its own in-chunk prefix, never a
+    neighbor's. int8 pools ride the same index map (`k_scale`/`v_scale`
+    sidecar pools). Gate with `paged_flash_prefill_usable`."""
+    B, T, nh, hs = q.shape
+    assert B == 1, "chunk prefill attends one sequence at a time"
+    bs, nkv = k.shape[1], k.shape[2]
+    n_max = block_tables.shape[1]
+    rep = nh // nkv
+    quantized = k_scale is not None
+    assert quantized == (v_scale is not None), \
+        "int8 cache needs both k_scale and v_scale"
+
+    meta = jnp.reshape(jnp.asarray(q_offset, jnp.int32), (1,))
+    bt = jnp.asarray(block_tables, jnp.int32).reshape(n_max)
+    # pack (t, rep) into the sublane dim: row r of kv head g is query
+    # head g*rep + r%rep at chunk position r//rep
+    q3 = q[0].reshape(T, nkv, rep, hs).transpose(1, 0, 2, 3) \
+        .reshape(nkv, T * rep, hs)
+
+    def q_idx(j, meta_ref, bt_ref):
+        return (0, 0, 0)
+
+    def kv_idx(j, meta_ref, bt_ref):
+        last = jax.lax.div(jnp.maximum(meta_ref[0] + T, 1) - 1, bs)
+        return (bt_ref[jnp.minimum(j, last)], 0, 0, 0)
+
+    in_specs = [pl.BlockSpec((nkv, T * rep, hs), q_idx)]
+    operands = [q3]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec((1, bs, nkv, hs), kv_idx),
+            pl.BlockSpec((1, bs, nkv, 1), kv_idx),
+            pl.BlockSpec((1, bs, nkv, hs), kv_idx),
+            pl.BlockSpec((1, bs, nkv, 1), kv_idx),
+        ]
+        operands += [k, k_scale.astype(jnp.float32),
+                     v, v_scale.astype(jnp.float32)]
+        body = _prefill_kernel_q8
+    else:
+        in_specs += [
+            pl.BlockSpec((1, bs, nkv, hs), kv_idx),
+            pl.BlockSpec((1, bs, nkv, hs), kv_idx),
+        ]
+        operands += [k, v]
+        body = _prefill_kernel
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n_max,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((nkv, T * rep, hs), q_idx),
+        scratch_shapes=[
+            pltpu.VMEM((nkv, T * rep, hs), jnp.float32),
+            pltpu.VMEM((nkv, T * rep, 1), jnp.float32),
+            pltpu.VMEM((nkv, T * rep, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(body, scale=float(scale), bs=bs, rep=rep),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((nkv, T * rep, hs), q.dtype),
+        compiler_params=tpu_compiler_params(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(meta, bt, *operands)
+    return out.reshape(nkv, T, rep, hs).transpose(1, 0, 2, 3) \
+        .reshape(1, T, nh, hs)
+
+
+def paged_flash_prefill_usable(q, k, v, block_tables) -> bool:
+    """Static gate for the chunk-prefill kernel, mirroring
+    `paged_flash_decode_usable`: one sequence's (1, T>1, nh, hs) chunk,
+    whole-block pool pages the hardware tiles, T a multiple of the
+    sublane step, and the packed query tile + f32 accumulator within the
+    VMEM budget. Callers fall back to paged_gather + the naive masked
+    path — identical semantics."""
+    if q.ndim != 4 or q.shape[0] != 1 or q.shape[1] <= 1:
+        return False
+    _, T, nh, hs = q.shape
+    bs, nkv = k.shape[1], k.shape[2]
+    if q.dtype not in (jnp.float32, jnp.bfloat16):
+        return False
+    if k.dtype != q.dtype and k.dtype != jnp.int8:
+        return False
+    if hs % 8 != 0 or nh % nkv != 0 or T % 8 != 0:
+        return False
+    on_tpu = jax.default_backend() == "tpu"
+    if bs % (128 if on_tpu else 8) != 0:
+        return False
+    from distributed_pytorch_tpu.parallel import context
+    mesh = context.get_mesh()
+    if mesh is not None and any(s > 1 for s in mesh.devices.shape):
+        return False
+    dsize = jnp.dtype(k.dtype).itemsize
+    rep = nh // nkv
+    rows = T * rep
+    tiles = 2 * 2 * bs * nkv * hs * dsize               # double-buffered k+v
+    if k.dtype == jnp.int8:
+        tiles += 2 * 2 * bs * nkv * 4                   # f32 scale rows
+    qtile = nkv * rows * hs * dsize
+    scratch = nkv * rows * (hs + 2) * 4
+    scores = 3 * nkv * rows * bs * 4
+    return tiles + qtile + scratch + scores <= _VMEM_BUDGET
 
 
 def paged_flash_decode_usable(q, k, v, block_tables) -> bool:

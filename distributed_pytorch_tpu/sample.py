@@ -138,6 +138,13 @@ def main(argv=None) -> None:
                         "decode matmuls read int8 codes + per-channel "
                         "scales (prefill stays bf16); routes decoding "
                         "through the DecodeEngine")
+    p.add_argument("--prefill-chunk", "--prefill_chunk",
+                   dest="prefill_chunk", type=int, default=0,
+                   help="Sarathi-style chunked prefill fused into the "
+                        "decode step (engine/decode.py): <=N prompt "
+                        "tokens per fused step; routes decoding through "
+                        "the DecodeEngine. 0 = legacy one-shot wave "
+                        "prefill (the baseline)")
     args = p.parse_args(argv)
 
     from distributed_pytorch_tpu.models.generate import make_generate_fn
@@ -165,10 +172,10 @@ def main(argv=None) -> None:
 
     import time
     n_new = args.num_samples * args.max_new_tokens
-    if args.cache_dtype or args.quant_weights:
-        # quantized serving knobs route through the DecodeEngine (the
-        # generate scan has no quantized path): one slot per sample,
-        # continuous batching degenerate to a single admit wave
+    if args.cache_dtype or args.quant_weights or args.prefill_chunk:
+        # quantized serving / chunked-prefill knobs route through the
+        # DecodeEngine (the generate scan has neither path): one slot per
+        # sample, continuous batching degenerate to a single admit wave
         from distributed_pytorch_tpu.engine import DecodeEngine
         eng = DecodeEngine(model, variables, n_slots=args.num_samples,
                            cache_dtype=args.cache_dtype or None,
@@ -177,14 +184,16 @@ def main(argv=None) -> None:
                            rng=jax.random.PRNGKey(args.seed),
                            mesh=mesh,
                            recipe=train_cfg.parallelism if mesh is not None
-                           else "single")
+                           else "single",
+                           prefill_chunk=args.prefill_chunk)
         t0 = time.perf_counter()
         outs = eng.run([ids] * args.num_samples, args.max_new_tokens)
         dt = time.perf_counter() - t0
         print(f"decode: {n_new} tokens in {dt:.2f}s "
               f"({n_new / dt:.1f} tok/s, incl. compile on first call; "
               f"engine, cache={jnp.dtype(eng.cache_dtype).name} "
-              f"quant_w={eng.weights_quantized})")
+              f"quant_w={eng.weights_quantized} "
+              f"prefill_chunk={eng.prefill_chunk or 'wave'})")
         for toks in outs:
             print("-" * 40)
             print(enc.decode(toks) if enc is not None else toks)

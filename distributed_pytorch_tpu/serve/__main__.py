@@ -61,6 +61,14 @@ def build_args(argv=None):
     p.add_argument("--no-prefix-cache", dest="prefix_cache",
                    action="store_false",
                    help="disable radix prefix reuse (A/B baseline)")
+    p.add_argument("--prefill-chunk", "--prefill_chunk",
+                   dest="prefill_chunk", type=int, default=0,
+                   help="fuse Sarathi-style chunked prefill into the "
+                        "decode step: <=N prefill tokens ride each fused "
+                        "step so live streams never stall on a prompt "
+                        "(multiple of --kv-block; pick N >= slots + "
+                        "kv-block). 0 = legacy all-or-nothing wave "
+                        "prefill (the A/B baseline)")
     return p.parse_args(argv)
 
 
@@ -104,7 +112,8 @@ async def _amain(args) -> None:
                        rng=jax.random.PRNGKey(args.seed),
                        mesh=mesh, recipe=recipe,
                        block_size=args.kv_block, n_blocks=args.kv_blocks,
-                       prefix_cache=args.prefix_cache)
+                       prefix_cache=args.prefix_cache,
+                       prefill_chunk=args.prefill_chunk)
     sched = Scheduler(eng, max_queue=args.max_queue,
                       default_deadline_s=args.deadline_s)
     app = ServeApp(sched, host=args.host, port=args.port, encoder=encoder,
@@ -116,7 +125,8 @@ async def _amain(args) -> None:
           f"cache={'int8' if eng.kv_quantized else 'native'}, "
           f"quant_w={eng.weights_quantized}, "
           f"blocks={eng.n_blocks}x{eng.block_size}, "
-          f"prefix_cache={eng.prefix_cache})")
+          f"prefix_cache={eng.prefix_cache}, "
+          f"prefill_chunk={eng.prefill_chunk or 'wave'})")
     print(f"  curl -N -X POST http://{args.host}:{app.port}/v1/completions "
           "-d '{\"prompt\": [1, 2, 3], \"max_tokens\": 16}'")
     try:

@@ -32,6 +32,10 @@ from typing import Callable, Optional
 LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                    0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
 
+# Per-step prefill token counts (chunked prefill): pow2 grid up to the
+# largest plausible chunk budget — the knob this histogram tunes.
+PREFILL_TOKEN_BUCKETS = (0, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
+
 
 class Histogram:
     """Prometheus-style cumulative histogram + exact quantiles."""
@@ -83,16 +87,16 @@ class Histogram:
         lines.append(f"{self.name}_count {self.count}")
         return lines
 
-    def summary(self) -> dict:
-        """p50/p99/max/mean in milliseconds for the bench leg JSON."""
+    def summary(self, unit: str = "ms", scale: float = 1e3) -> dict:
+        """p50/p99/max/mean for the bench leg JSON — milliseconds by
+        default; token-valued histograms pass unit='tok', scale=1."""
         if not self.count:
             return {"count": 0}
-        ms = 1e3
         return {"count": self.count,
-                "p50_ms": round((self.quantile(0.50) or 0.0) * ms, 3),
-                "p99_ms": round((self.quantile(0.99) or 0.0) * ms, 3),
-                "max_ms": round((self.max or 0.0) * ms, 3),
-                "mean_ms": round(self.sum / self.count * ms, 3)}
+                f"p50_{unit}": round((self.quantile(0.50) or 0.0) * scale, 3),
+                f"p99_{unit}": round((self.quantile(0.99) or 0.0) * scale, 3),
+                f"max_{unit}": round((self.max or 0.0) * scale, 3),
+                f"mean_{unit}": round(self.sum / self.count * scale, 3)}
 
 
 class ServeMetrics:
@@ -108,6 +112,7 @@ class ServeMetrics:
                 "prefix_hit_tokens", "prefix_miss_tokens")
 
     def __init__(self):
+        self._gauges: dict[str, tuple[Callable[[], float], str]] = {}
         self.ttft = Histogram(
             "serve_ttft_seconds",
             "submit to first streamed token (queue wait + bucketed prefill)")
@@ -118,12 +123,24 @@ class ServeMetrics:
             "serve_e2e_seconds", "submit to retirement")
         self.queue_wait = Histogram(
             "serve_queue_wait_seconds", "submit to slot admission")
+        # chunked-prefill observability (round 12): the per-step prefill
+        # token distribution is the chunk-size knob's tuning signal —
+        # p50 near the chunk budget means prefill-bound, near 0 means the
+        # budget is slack — and decode_stall tracks how long live decode
+        # streams sat behind monolithic (wave) prefill work.
+        self.prefill_tokens_per_step = Histogram(
+            "serve_prefill_tokens_per_step",
+            "prefill tokens executed per fused step (chunked mode) or "
+            "per admission (wave mode)", buckets=PREFILL_TOKEN_BUCKETS)
+        self.decode_stall_s = 0.0
+        self.register_gauge(
+            "serve_decode_stall_ms", lambda: self.decode_stall_s * 1e3,
+            "cumulative time decode slots sat idle behind prefill work")
         self.counters = dict.fromkeys(self.COUNTERS, 0)
         self.shed_counts: dict[str, int] = {}     # cause -> n
         self.retire_counts: dict[str, int] = {}   # reason -> n
         self._occ_sum = 0.0
         self._occ_n = 0
-        self._gauges: dict[str, tuple[Callable[[], float], str]] = {}
 
     # ------------------------------------------------------------------
     def inc(self, name: str, n: int = 1) -> None:
@@ -135,6 +152,12 @@ class ServeMetrics:
 
     def retired(self, reason: str) -> None:
         self.retire_counts[reason] = self.retire_counts.get(reason, 0) + 1
+
+    def stall(self, seconds: float) -> None:
+        """Account time live decode streams spent waiting on prefill work
+        (a monolithic wave admission ran while slots held live streams —
+        ~0 in chunked mode, where prefill rides the fused step)."""
+        self.decode_stall_s += seconds
 
     def observe_occupancy(self, frac: float) -> None:
         """Record the live-slot fraction seen by one fused step."""
@@ -154,7 +177,8 @@ class ServeMetrics:
     def render_prometheus(self) -> str:
         """The `/metrics` payload (Prometheus text exposition 0.0.4)."""
         lines: list[str] = []
-        for h in (self.ttft, self.itl, self.e2e, self.queue_wait):
+        for h in (self.ttft, self.itl, self.e2e, self.queue_wait,
+                  self.prefill_tokens_per_step):
             lines += h.render()
         lines += ["# HELP serve_requests_total request lifecycle counters",
                   "# TYPE serve_requests_total counter"]
@@ -196,6 +220,9 @@ class ServeMetrics:
         out = {"ttft": self.ttft.summary(), "itl": self.itl.summary(),
                "e2e": self.e2e.summary(),
                "queue_wait": self.queue_wait.summary(),
+               "prefill_tokens_per_step":
+                   self.prefill_tokens_per_step.summary(unit="tok",
+                                                        scale=1.0),
                "mean_occupancy": round(self.mean_occupancy, 4)}
         out.update(self.counters)
         if self.shed_counts:

@@ -20,7 +20,14 @@ module gives the host side:
   bucket so same-bucket prefills run back-to-back on one compiled trace
   (`DecodeEngine.prefill_bucket`; the engine compiles one prefill per
   bucket, so grouping maximizes warm-trace reuse without reordering
-  across waves).
+  across waves). With a CHUNKED engine (`prefill_chunk > 0`) admission
+  is bookkeeping only — no prefill runs, no bucket traces exist — so the
+  wave stays pure FCFS and the prompt chunks into subsequent fused steps
+  under the engine's token budget (decode tokens keep strict priority;
+  the request's first token arrives via `StepResult.emitted` when its
+  last chunk runs). TTFT is therefore observed when the FIRST TOKEN is
+  pushed, not at admission — identical timing in wave mode, and the only
+  correct point in chunked mode.
 * **One background step loop**: a single task owns the engine; every
   engine call (admit/step) runs in a one-thread executor so a ~ms fused
   step never blocks the event loop's HTTP writes. Tokens fan out to
@@ -276,6 +283,20 @@ class Scheduler:
         dropped)."""
         return max(0, len(tokens) - req.served)
 
+    def _emit_token(self, req: _Request, tok: int, now: float) -> None:
+        """Push one generated token to the handle with the latency
+        bookkeeping: the request's first-ever token is its TTFT (true
+        submit-to-token wait, whether it came from a wave admission or
+        the fused step that ran the prompt's last chunk); every later
+        token is an ITL sample."""
+        if req.served == 0:
+            self.metrics.ttft.observe(now - req.submitted_at)
+        else:
+            self.metrics.itl.observe(now - req.last_tok_at)
+        req.last_tok_at = now
+        self.metrics.inc("tokens_out")
+        req.handle._push_token(tok)
+
     def _request_cancel(self, req: _Request) -> None:
         if req.cancelled or req.handle.retired is not None \
                 or req.handle.error is not None:
@@ -336,11 +357,54 @@ class Scheduler:
     async def _admit_wave(self, loop) -> None:
         """Fill every free slot from the queue head. FCFS across waves;
         within the wave a stable bucket sort makes same-bucket prompts
-        prefill consecutively on one compiled trace."""
+        prefill consecutively on one compiled trace (wave mode only —
+        a chunked engine has no prefill traces to group, so its waves
+        stay pure FCFS)."""
         n = min(self.engine.n_free, len(self._queue))
         if not n:
             return
+        chunked = getattr(self.engine, "prefill_chunk", 0) > 0
         wave = [self._queue.popleft() for _ in range(n)]
+        if chunked:
+            # chunked admission is bookkeeping-only (no prefill runs), so
+            # the whole wave admits in ONE executor round-trip — live
+            # streams wait one thread hop between steps, not one per
+            # admitted request
+            admitted: list = []
+
+            def _admit_batch():
+                for req in wave:
+                    if req.cancelled:
+                        admitted.append(None)
+                        continue
+                    try:
+                        admitted.append(
+                            self.engine.admit(req.prompt, req.max_new))
+                    except NoFreeBlocks:
+                        break          # remainder stays queued, in order
+                return admitted
+
+            await loop.run_in_executor(self._exec, _admit_batch)
+            now = time.perf_counter()
+            for req, adm in zip(wave, admitted):
+                if adm is None:        # cancelled while queued
+                    self.metrics.inc("cancelled")
+                    req.handle._push_done(Retired(
+                        tokens=list(req.prompt), reason="cancelled",
+                        prompt_len=self._caller_prompt_len(req,
+                                                           req.prompt)))
+                    continue
+                req.seq_id = adm.seq_id
+                req.admitted_at = now
+                self.metrics.inc("admitted")
+                self.metrics.inc("prefix_hit_tokens", adm.prefix_len)
+                self.metrics.inc("prefix_miss_tokens", adm.prefilled)
+                if not req.resumed:
+                    self.metrics.queue_wait.observe(now - req.submitted_at)
+                self._live[adm.seq_id] = req
+            for r in reversed(wave[len(admitted):]):  # NoFreeBlocks tail
+                self._queue.appendleft(r)
+            return
         wave.sort(key=lambda r: self.engine.prefill_bucket(
             min(len(r.prompt), self.engine.max_len - 1)))
         for i, req in enumerate(wave):
@@ -350,6 +414,11 @@ class Scheduler:
                     tokens=list(req.prompt), reason="cancelled",
                     prompt_len=self._caller_prompt_len(req, req.prompt)))
                 continue
+            # live streams stall for the whole admission in wave mode
+            # (the monolithic bucket prefill runs here); a chunked admit
+            # is bookkeeping-only, so the same measurement stays ~0
+            stalled = bool(self._live)
+            t0 = time.perf_counter()
             try:
                 adm = await loop.run_in_executor(
                     self._exec, self.engine.admit, req.prompt, req.max_new)
@@ -361,17 +430,21 @@ class Scheduler:
                     self._queue.appendleft(r)
                 return
             now = time.perf_counter()
+            if stalled:
+                self.metrics.stall(now - t0)
             req.seq_id = adm.seq_id
             req.admitted_at = now
-            req.last_tok_at = now
+            # last_tok_at is NOT reset here: _emit_token stamps it, and a
+            # resumed request's next ITL sample should span the whole
+            # client-visible preemption gap
             self.metrics.inc("admitted")
             self.metrics.inc("prefix_hit_tokens", adm.prefix_len)
             self.metrics.inc("prefix_miss_tokens", adm.prefilled)
             if not req.resumed:
                 self.metrics.queue_wait.observe(now - req.submitted_at)
-                self.metrics.ttft.observe(now - req.submitted_at)
-            self.metrics.inc("tokens_out")
-            req.handle._push_token(adm.first_token)
+            if adm.first_token is not None:    # wave mode: TTFT token now
+                self.metrics.prefill_tokens_per_step.observe(adm.prefilled)
+                self._emit_token(req, adm.first_token, now)
             if adm.retired is not None:        # finished at prefill
                 self._finish(req, adm.retired, now)
             else:
@@ -440,14 +513,16 @@ class Scheduler:
                 res = await loop.run_in_executor(self._exec,
                                                  self.engine.step)
                 now = time.perf_counter()
+                if getattr(self.engine, "prefill_chunk", 0):
+                    # per-step chunk budget use: the chunk-size tuning
+                    # signal (p50 ~ budget => prefill-bound, ~0 => slack)
+                    self.metrics.prefill_tokens_per_step.observe(
+                        res.prefill_tokens)
                 for sid, tok in res.emitted.items():
                     req = self._live.get(sid)
                     if req is None:            # cancelled mid-flight
                         continue
-                    self.metrics.itl.observe(now - req.last_tok_at)
-                    req.last_tok_at = now
-                    self.metrics.inc("tokens_out")
-                    req.handle._push_token(tok)
+                    self._emit_token(req, tok, now)
                 requeued: list[_Request] = []
                 for sid, ret in res.retired.items():
                     req = self._live.pop(sid, None)

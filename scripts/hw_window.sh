@@ -3,10 +3,15 @@
 # on hour timescales, so the moment a probe succeeds this script grabs, in
 # priority order, everything the round needs from real silicon:
 #   1. bench.py            — the headline MFU number (its mini-sweep already
-#                            A/Bs flash/slab/streaming-CE legs; worst case
-#                            ~75 min if the tunnel goes half-up mid-bench,
-#                            so the cap is 90 min — bench always prints its
-#                            JSON line if allowed to finish)
+#                            A/Bs flash/slab/streaming-CE legs plus the
+#                            decode/serve bundle: flash-vs-naive, int8,
+#                            paged-prefix serve_load_prefix, and the
+#                            round-12 serve_load_chunked chunk-size sweep
+#                            — BENCH_PREFILL_CHUNK 128/256/512 vs the wave
+#                            baseline; worst case ~75 min if the tunnel
+#                            goes half-up mid-bench, so the cap is 90 min —
+#                            bench always prints its JSON line if allowed
+#                            to finish)
 #   2. mfu_sweep blocks    — the flash block/layout/CE ablation inside the
 #                            real train step (decides the dispatch default)
 #   3. profile_step        — per-op device-time table of the best config

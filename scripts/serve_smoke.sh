@@ -1,8 +1,11 @@
 #!/usr/bin/env bash
 # Hermetic serving smoke: start the HTTP front-end on a demo model (no
 # checkpoint needed), stream one SSE completion, read /healthz and
-# /metrics, shut down. Pass --ckpt <dir> as $1/$2 to smoke a real
-# checkpoint instead of the random-init demo model.
+# /metrics, shut down — then repeat with chunked prefill enabled
+# (--prefill-chunk: <=N prompt tokens fused into each decode step) so
+# the chunked path gets an e2e HTTP exercise too. Pass --ckpt <dir> as
+# $1/$2 to smoke a real checkpoint instead of the random-init demo
+# model.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -12,23 +15,35 @@ if [ "${1:-}" = "--ckpt" ]; then
   SRC_ARGS=("--ckpt" "$2")
 fi
 
-python -m distributed_pytorch_tpu.serve "${SRC_ARGS[@]}" \
-  --port "$PORT" --slots 2 --max-queue 8 --temperature 0.0 &
-SERVER_PID=$!
-trap 'kill $SERVER_PID 2>/dev/null || true' EXIT
+smoke_one() {  # $@ = extra server args
+  python -m distributed_pytorch_tpu.serve "${SRC_ARGS[@]}" \
+    --port "$PORT" --slots 2 --max-queue 8 --temperature 0.0 "$@" &
+  SERVER_PID=$!
+  trap 'kill $SERVER_PID 2>/dev/null || true' EXIT
 
-for _ in $(seq 1 60); do
-  curl -sf "http://127.0.0.1:$PORT/healthz" >/dev/null 2>&1 && break
-  sleep 1
-done
-curl -sf "http://127.0.0.1:$PORT/healthz"; echo
+  for _ in $(seq 1 60); do
+    curl -sf "http://127.0.0.1:$PORT/healthz" >/dev/null 2>&1 && break
+    sleep 1
+  done
+  curl -sf "http://127.0.0.1:$PORT/healthz"; echo
 
-echo "--- SSE stream ---"
-curl -sN -X POST "http://127.0.0.1:$PORT/v1/completions" \
-  -d '{"prompt": [1, 2, 3], "max_tokens": 8}'
+  echo "--- SSE stream ---"
+  curl -sN -X POST "http://127.0.0.1:$PORT/v1/completions" \
+    -d '{"prompt": [1, 2, 3], "max_tokens": 8}'
 
-echo "--- /metrics (ttft + lifecycle) ---"
-curl -sf "http://127.0.0.1:$PORT/metrics" \
-  | grep -E 'serve_ttft_seconds_count|serve_requests_total|serve_slot_occupancy'
+  echo "--- /metrics (ttft + lifecycle) ---"
+  curl -sf "http://127.0.0.1:$PORT/metrics" \
+    | grep -E 'serve_ttft_seconds_count|serve_requests_total|serve_slot_occupancy'
+
+  kill $SERVER_PID 2>/dev/null || true
+  wait $SERVER_PID 2>/dev/null || true
+  trap - EXIT
+}
+
+echo "=== wave-prefill smoke ==="
+smoke_one
+
+echo "=== chunked-prefill smoke (--prefill-chunk 32) ==="
+smoke_one --prefill-chunk 32
 
 echo "serve smoke OK"

@@ -225,6 +225,142 @@ def test_engine_paged_kernel_matches_naive(monkeypatch):
     assert eng.run(PROMPTS[:3], max_new_tokens=5) == refs
 
 
+# ----------------------------------------------------------------------
+# chunked prefill fused into the decode step (prefill_chunk > 0)
+# ----------------------------------------------------------------------
+
+# mixed mix on purpose: a trivial prompt, a multi-chunk long prompt, and
+# a mid-size one — lengths chosen so prompt + budget stays under max_len
+# (past it the engine retires 'cache_full' by design and the one-shot
+# oracle no longer defines the answer)
+CHUNK_PROMPTS = [[1, 2, 3], list(range(1, 40)), [7] * 10]
+
+
+@pytest.mark.parametrize("cache_dtype", [None, "int8"],
+                         ids=["native", "int8"])
+@pytest.mark.parametrize("kw", [
+    dict(attn="mha", n_kv_heads=4, pos_emb="learn"),
+    dict(attn="gqa", n_kv_heads=2, pos_emb="rope"),
+    dict(attn="mla", pos_emb="rope"),
+], ids=["mha", "gqa", "mla"])
+def test_chunked_matches_oneshot(kw, cache_dtype):
+    """Chunked-vs-oneshot greedy bit-parity matrix: splitting a prompt
+    into fused <=16-token chunks must be invisible in the tokens for
+    dense/GQA/MLA and for the int8 KV cache (per-row scales make the
+    quantization chunking-independent). The native legs are also pinned
+    against the one-shot `generate` oracle; int8 legs against the wave
+    engine (the int8-vs-bf16 tolerance is test_quant.py's contract)."""
+    cfg = tiny_cfg(**kw)
+    model, variables = build(cfg)
+    kwargs = dict(n_slots=2, temperature=0.0, min_bucket=8, block_size=8,
+                  cache_dtype=cache_dtype)
+    wave = DecodeEngine(model, variables, **kwargs)
+    refs = wave.run([list(p) for p in CHUNK_PROMPTS], max_new_tokens=12)
+    if cache_dtype is None:
+        for p, r in zip(CHUNK_PROMPTS, refs):
+            assert r == generate(model, variables,
+                                 jnp.asarray(p, jnp.int32)[None], 12,
+                                 temperature=0.0)[0].tolist()
+    eng = DecodeEngine(model, variables, prefill_chunk=16, **kwargs)
+    outs = eng.run([list(p) for p in CHUNK_PROMPTS], max_new_tokens=12)
+    assert outs == refs, "chunked prefill changed the greedy output"
+    assert eng.fused_step_traces == 1
+    assert eng.admit_traces == {}, "chunked admission must not prefill"
+
+
+@pytest.mark.parametrize("prefix_cache", [True, False],
+                         ids=["prefix-on", "prefix-off"])
+def test_chunked_prefix_reuse_bit_identical(prefix_cache):
+    """Chunking composes with radix prefix matching: a re-admitted prompt
+    hits the blocks its own chunks registered (chunk boundaries register
+    full blocks as they fill — not only at retirement) and skips straight
+    to the tail, still bit-identical to the oracle; the prefix-off
+    baseline re-chunks everything and must agree too."""
+    cfg = tiny_cfg()
+    model, variables = build(cfg)
+    eng = DecodeEngine(model, variables, n_slots=1, temperature=0.0,
+                       min_bucket=8, prefill_chunk=16, block_size=8,
+                       prefix_cache=prefix_cache)
+    p = list(range(1, 40))
+    ref = generate(model, variables, jnp.asarray(p, jnp.int32)[None], 12,
+                   temperature=0.0)[0].tolist()
+    assert eng.run([list(p)], max_new_tokens=12)[0] == ref
+    # second admission of the same prompt: block-aligned prefix served
+    # from cache (the partial tail stays private, so < len(p))
+    assert eng.run([list(p)], max_new_tokens=12)[0] == ref
+    if prefix_cache:
+        assert 0 < eng.prefix_hit_tokens < 2 * len(p)
+    else:
+        assert eng.prefix_hit_tokens == 0
+        assert eng.prefilled_tokens == 2 * len(p)
+    assert eng.fused_step_traces == 1
+
+
+@pytest.mark.parametrize("prefix_cache", [True, False],
+                         ids=["prefix-on", "prefix-off"])
+def test_chunked_mid_prefill_preemption_bit_identical(prefix_cache):
+    """A pool too small for a decode stream plus a multi-chunk prompt
+    preempts the partial MID-PREFILL; run() requeues it and the resume
+    (a prefix hit on its already-written blocks when the cache is on, a
+    full re-chunk when off) still produces oracle-identical tokens."""
+    cfg = tiny_cfg()
+    model, variables = build(cfg)
+    # bs=8: the 39-token prompt needs 5 blocks mid-prefill and 8 by
+    # budget end, the short stream grows to 3 — 8 usable blocks force a
+    # preemption while the long prompt is still chunking in
+    eng = DecodeEngine(model, variables, n_slots=2, temperature=0.0,
+                       min_bucket=8, prefill_chunk=16, block_size=8,
+                       n_blocks=9, prefix_cache=prefix_cache)
+    prompts = [[1, 2, 3], list(range(1, 40))]
+    outs = eng.run([list(p) for p in prompts], max_new_tokens=20)
+    assert eng.retire_counts["preempted"] >= 1, \
+        "pool was sized to force a mid-prefill preemption"
+    for p, o in zip(prompts, outs):
+        ref = generate(model, variables, jnp.asarray(p, jnp.int32)[None],
+                       20, temperature=0.0)[0].tolist()
+        assert o == ref, "mid-prefill preemption changed the output"
+    assert (eng.prefix_hit_tokens > 0) == prefix_cache
+    assert eng.block_pool.n_referenced == 0      # nothing leaked
+
+
+def test_chunked_single_fused_trace_across_prompt_mix():
+    """ONE fused-step trace regardless of prompt mix: chunk slot, write
+    offset, and valid length are traced arguments, so 1-token prompts,
+    multi-chunk prompts, and back-to-back runs all share the compiled
+    program — and chunked admission adds zero prefill traces."""
+    cfg = tiny_cfg()
+    model, variables = build(cfg)
+    eng = DecodeEngine(model, variables, n_slots=3, temperature=0.0,
+                       min_bucket=8, prefill_chunk=16, block_size=8)
+    eng.run([[9], [1, 2, 3], list(range(1, 40)), [7] * 10, [42, 43]],
+            max_new_tokens=5)
+    assert eng.fused_step_traces == 1
+    assert eng.step_traces <= 1          # pure-decode steps share one too
+    assert eng.admit_traces == {}
+    eng.run([[2, 4, 6], list(range(50, 80))], max_new_tokens=4)
+    assert eng.fused_step_traces == 1
+    assert eng.step_traces <= 1
+    assert eng.admit_traces == {}
+
+
+def test_chunked_engine_kernel_matches_naive(monkeypatch):
+    """FLASH_DECODE=on drives the fused chunk through the paged chunk-
+    prefill kernel (interpret off-TPU) and decode through the paged
+    decode kernel — tokens must match the FLASH_DECODE=off gather+naive
+    chunked engine exactly."""
+    cfg = tiny_cfg()
+    model, variables = build(cfg, attn_impl="auto")
+    kwargs = dict(n_slots=2, temperature=0.0, min_bucket=8,
+                  prefill_chunk=16, block_size=8)
+    monkeypatch.setenv("FLASH_DECODE", "off")
+    ref_eng = DecodeEngine(model, variables, **kwargs)
+    refs = ref_eng.run([list(p) for p in CHUNK_PROMPTS], max_new_tokens=8)
+    monkeypatch.setenv("FLASH_DECODE", "on")
+    eng = DecodeEngine(model, variables, **kwargs)
+    assert eng.run([list(p) for p in CHUNK_PROMPTS],
+                   max_new_tokens=8) == refs
+
+
 def test_engine_fsdp_mesh_runs():
     """fsdp recipe: params sharded over 'data', slot axis of the cache
     sharded over 'data' (2 slots x dp2)."""

@@ -357,3 +357,104 @@ def test_sdpa_decode_scalar_offset_under_jit(monkeypatch):
     ref = jax.jit(run)(jnp.int32(7))
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                atol=1e-5, rtol=1e-5)
+
+
+# ----------------------------------------------------------------------
+# chunk-prefill kernel (mixed prefill+decode path, round 12)
+# ----------------------------------------------------------------------
+
+def _mk_chunk(T, n_max, bs, nh, nkv, hs, seed=0):
+    """One sequence's pool + shuffled block table for the chunk kernel:
+    (1, T, nh, hs) query rows at global positions [off, off+T)."""
+    import numpy as np_
+
+    from distributed_pytorch_tpu.ops.block_pool import paged_gather
+    n_blocks = 1 + n_max + 4                     # + null block 0
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (1, T, nh, hs))
+    kp = jax.random.normal(ks[1], (n_blocks, bs, nkv, hs))
+    vp = jax.random.normal(ks[2], (n_blocks, bs, nkv, hs))
+    rng = np_.random.default_rng(seed)
+    bt = jnp.asarray(rng.permutation(np_.arange(1, 1 + n_max))
+                     .reshape(1, n_max).astype(np_.int32))
+    return q, kp, vp, bt, paged_gather(kp, bt), paged_gather(vp, bt)
+
+
+@pytest.mark.parametrize("off", [0, 8, 24], ids=lambda o: f"off{o}")
+@pytest.mark.parametrize("nkv", [8, 4, 1], ids=lambda n: f"nkv{n}")
+def test_chunk_prefill_parity_offsets(nkv, off):
+    """paged_flash_prefill vs the naive path on the gathered logical
+    view: a 16-row chunk at block-aligned offsets (fresh sequence, one
+    prior block, three prior blocks) attends its prior context plus its
+    own in-chunk causal prefix — MHA through MQA, shuffled tables."""
+    from distributed_pytorch_tpu.ops.flash_decode import (
+        paged_flash_prefill, paged_flash_prefill_usable)
+    T, n_max, bs, nh, hs = 16, 8, 8, 8, 16
+    q, kp, vp, bt, kl, vl = _mk_chunk(T, n_max, bs, nh, nkv, hs, seed=off)
+    assert paged_flash_prefill_usable(q, kp, vp, bt)
+    out = paged_flash_prefill(q, kp, vp, bt, jnp.int32(off),
+                              scale=hs ** -0.5, interpret=True)
+    ref = _naive_sdpa(q, kl, vl, scale=hs ** -0.5, q_offset=off)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_chunk_prefill_parity_int8():
+    """int8 pools ride the chunk kernel's block-table index map; the
+    in-kernel dequant owes the dequantized gathered oracle full parity
+    (exact algebra, same as the decode kernel's contract)."""
+    from distributed_pytorch_tpu.ops.block_pool import paged_gather
+    from distributed_pytorch_tpu.ops.flash_decode import paged_flash_prefill
+    from distributed_pytorch_tpu.ops.quant import dequantize_int8, quantize_kv
+    T, n_max, bs, nh, nkv, hs = 16, 8, 8, 8, 4, 16
+    q, kp, vp, bt, _, _ = _mk_chunk(T, n_max, bs, nh, nkv, hs, seed=3)
+    kq, ks_ = quantize_kv(kp)
+    vq, vs = quantize_kv(vp)
+    out = paged_flash_prefill(q, kq, vq, bt, jnp.int32(8),
+                              scale=hs ** -0.5, k_scale=ks_, v_scale=vs,
+                              interpret=True)
+    kd = dequantize_int8(paged_gather(kq, bt), paged_gather(ks_, bt), q.dtype)
+    vd = dequantize_int8(paged_gather(vq, bt), paged_gather(vs, bt), q.dtype)
+    ref = _naive_sdpa(q, kd, vd, scale=hs ** -0.5, q_offset=8)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_chunk_prefill_trailing_blocks_fully_skipped():
+    """Blocks past the chunk's last needed one must contribute nothing:
+    the index-map clamp keeps the DMA on the last valid block, so poison
+    beyond it cannot leak into the chunk's rows."""
+    from distributed_pytorch_tpu.ops.flash_decode import paged_flash_prefill
+    T, n_max, bs, nh, nkv, hs = 16, 8, 8, 4, 4, 8
+    q, kp, vp, bt, _, _ = _mk_chunk(T, n_max, bs, nh, nkv, hs)
+    off = 8                                      # rows [8, 24): blocks 0..2
+    needed = {int(bt[0, j]) for j in range(3)}
+    mask = ~jnp.isin(jnp.arange(kp.shape[0]), jnp.asarray(list(needed)))
+    kp = jnp.where(mask[:, None, None, None], jnp.nan, kp)
+    vp = jnp.where(mask[:, None, None, None], jnp.inf, vp)
+    out = paged_flash_prefill(q, kp, vp, bt, jnp.int32(off),
+                              scale=hs ** -0.5, interpret=True)
+    assert bool(jnp.isfinite(out).all())
+
+
+def test_chunk_prefill_usable_gate_declines():
+    from distributed_pytorch_tpu.ops.flash_decode import \
+        paged_flash_prefill_usable
+    q, kp, vp, bt, _, _ = _mk_chunk(16, 8, 8, 8, 4, 16)
+    assert paged_flash_prefill_usable(q, kp, vp, bt)
+    # single-token (decode-shaped) query -> the decode kernel's job
+    assert not paged_flash_prefill_usable(q[:, :1], kp, vp, bt)
+    # chunk not a sublane multiple
+    assert not paged_flash_prefill_usable(q[:, :12], kp, vp, bt)
+    # batched chunks: one sequence at a time only
+    q2 = jnp.concatenate([q, q], axis=0)
+    assert not paged_flash_prefill_usable(q2, kp, vp, bt)
+    # block size the hardware cannot tile (9 rows)
+    q3, kp3, vp3, bt3, _, _ = _mk_chunk(16, 8, 9, 8, 4, 16)
+    assert not paged_flash_prefill_usable(q3, kp3, vp3, bt3)
+    # live multi-device mesh -> gather + naive carries sharded decode
+    from distributed_pytorch_tpu.parallel import context
+    from distributed_pytorch_tpu.parallel.mesh import mesh_for
+    with context.use_mesh(mesh_for("dp")):
+        assert not paged_flash_prefill_usable(q, kp, vp, bt)
+    assert paged_flash_prefill_usable(q, kp, vp, bt)

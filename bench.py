@@ -670,6 +670,73 @@ def _serve_chunked_bench(platform: str) -> dict:
             "preset": preset}
 
 
+def _serve_router_bench(platform: str) -> dict:
+    """serve_load_router leg (BENCH_SERVE=1 BENCH_SERVE_ROUTER=1): the
+    replicated-serving fault-tolerance A/B. Delegates to the
+    fault-injection harness (scripts/fault_inject.py): N real replica
+    subprocesses (demo model, greedy) behind the health-gated router,
+    seeded Poisson traffic at saturating load, one replica SIGKILLed
+    mid-drive and restarted on the same port, plus a single-replica
+    baseline drive for the scaling ratio. The three exit criteria ride
+    back as accept booleans: zero failed (vs explicitly shed) requests,
+    every completed stream — failed-over ones included — bit-identical
+    to offline greedy, and aggregate tok/s vs one replica. The replicas
+    are separate PROCESSES pinned to the CPU backend (per-chip replica
+    placement rides the TPU window), so the scaling ratio is only
+    meaningful with >= replicas+1 host cores — `scaling_measurable`
+    reports whether this box can express it at all (a 1-core CI
+    container cannot; the criterion evaluates on the bench host)."""
+    n_rep = int(os.environ.get("BENCH_ROUTER_REPLICAS", "3"))
+    n_req = int(os.environ.get("BENCH_ROUTER_REQUESTS", "48"))
+    mode = os.environ.get("BENCH_ROUTER_MODE", "kill")
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "fault_inject.py")
+    cmd = [sys.executable, script, "--json", "--baseline",
+           "--replicas", str(n_rep), "--requests", str(n_req),
+           "--mode", mode,
+           "--load", os.environ.get("BENCH_SERVE_LOAD", "1.2"),
+           "--retry-budget", "4"]
+    r = subprocess.run(cmd, capture_output=True, timeout=850)
+    sys.stderr.write(r.stderr.decode()[-2000:])
+    out = None
+    for line in reversed(r.stdout.decode().strip().splitlines()):
+        try:
+            out = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    if out is None:
+        return {"metric": "serve_router_error", "value": 0,
+                "unit": "error", "vs_baseline": 0,
+                "error": f"harness rc={r.returncode}, no JSON",
+                "stdout_tail": r.stdout.decode()[-500:]}
+    cores = out.get("host_cores", 1)
+    scaling = out.get("scaling_x", 0.0)
+    accept = {
+        # ROADMAP exit criteria for the scale-out item
+        "zero_failed": out["failed"] == 0,
+        "failover_parity": out["parity_mismatches"] == 0,
+        # the killed replica rejoined through the backoff prober
+        # (replica_up counts initial probes + the rejoin)
+        "replica_rejoined": out["replica_up"] > n_rep,
+        "linear_scaling": scaling >= max(1.0, 0.83 * n_rep),
+        "scaling_measurable": cores >= n_rep + 1,
+    }
+    return {"metric": ("serve_router_tokens_per_sec" if platform == "tpu"
+                       else "cpu_proxy_serve_router_tokens_per_sec"),
+            "value": out["tokens_per_sec"], "unit": "tok/s",
+            "vs_baseline": 0, "accept": accept, "host_cores": cores,
+            "scaling_x": scaling,
+            "baseline_tokens_per_sec":
+                out.get("baseline_tokens_per_sec"),
+            **{k: out[k] for k in
+               ("replicas", "mode", "requests", "completed", "shed",
+                "failed", "parity_mismatches", "failovers", "retries",
+                "replica_down", "replica_up", "offered_rps",
+                "ttft_p50_ms", "ttft_p99_ms", "itl_p50_ms",
+                "itl_p99_ms", "shed_by_cause") if k in out}}
+
+
 def run_bench(platform: str, only_recipe: str | None = None) -> dict:
     """Worker-side measurement. `platform` is 'tpu' or 'cpu'.
 
@@ -703,6 +770,9 @@ def run_bench(platform: str, only_recipe: str | None = None) -> dict:
     n_dev = len(jax.devices())
 
     if os.environ.get("BENCH_SERVE"):
+        if os.environ.get("BENCH_SERVE_ROUTER"):
+            # subprocess replicas pin their own backend; no TPU assert
+            return _serve_router_bench(platform)
         if platform == "tpu":
             assert jax.default_backend() == "tpu", \
                 f"TPU probe passed but worker got {jax.default_backend()!r}"
@@ -999,7 +1069,13 @@ def main() -> None:
                     # the wave baseline (ITL p99 flat vs unbounded stall)
                     ("serve_load_chunked",
                      {"BENCH_SERVE": "1", "FLASH_DECODE": "on",
-                      "BENCH_PREFILL_CHUNK": "128,256,512"})]:
+                      "BENCH_PREFILL_CHUNK": "128,256,512"}),
+                    # PR 8: replicated serving behind the fault-tolerant
+                    # router — 3 replica processes, one SIGKILLed
+                    # mid-Poisson-drive and replaced; zero-failed /
+                    # failover-parity / scaling accept booleans
+                    ("serve_load_router",
+                     {"BENCH_SERVE": "1", "BENCH_SERVE_ROUTER": "1"})]:
                 r = _spawn_worker("tpu", timeout_s=900, extra_env=env)
                 if r:
                     decode_results[name] = r

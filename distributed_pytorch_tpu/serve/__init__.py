@@ -1,12 +1,20 @@
 """Online serving layer over engine/DecodeEngine: asyncio request
 scheduler (scheduler.py), stdlib streaming HTTP front-end (server.py),
-and serve-side metrics (metrics.py). Start a server with
-`python -m distributed_pytorch_tpu.serve --ckpt <dir>`."""
+serve-side metrics (metrics.py), and the fault-tolerant replicated
+router tier (router.py). Start a replica with
+`python -m distributed_pytorch_tpu.serve --ckpt <dir>`, a router over
+N replicas with `python -m distributed_pytorch_tpu.serve.router
+--replicas 127.0.0.1:8001,127.0.0.1:8002`."""
 
-from distributed_pytorch_tpu.serve.metrics import ServeMetrics
-from distributed_pytorch_tpu.serve.scheduler import (RequestHandle,
+from distributed_pytorch_tpu.serve.metrics import (RouterMetrics,
+                                                   ServeMetrics)
+from distributed_pytorch_tpu.serve.router import (Replica, Router,
+                                                  RouterApp)
+from distributed_pytorch_tpu.serve.scheduler import (EngineError,
+                                                     RequestHandle,
                                                      Scheduler, ShedError)
 from distributed_pytorch_tpu.serve.server import ServeApp
 
-__all__ = ["Scheduler", "RequestHandle", "ShedError", "ServeMetrics",
-           "ServeApp"]
+__all__ = ["Scheduler", "RequestHandle", "ShedError", "EngineError",
+           "ServeMetrics", "RouterMetrics", "ServeApp", "Replica",
+           "Router", "RouterApp"]

@@ -61,6 +61,16 @@ def build_args(argv=None):
     p.add_argument("--no-prefix-cache", dest="prefix_cache",
                    action="store_false",
                    help="disable radix prefix reuse (A/B baseline)")
+    p.add_argument("--cpu", action="store_true",
+                   help="pin the CPU backend via a live jax.config update "
+                        "(env vars are too late on images whose "
+                        "sitecustomize pre-registers a TPU backend) — "
+                        "what the fault-injection harness's replica "
+                        "subprocesses use")
+    p.add_argument("--request-timeout-s", "--request_timeout_s",
+                   dest="request_timeout_s", type=float, default=30.0,
+                   help="per-connection read timeout while parsing a "
+                        "request (stalled clients get 408)")
     p.add_argument("--prefill-chunk", "--prefill_chunk",
                    dest="prefill_chunk", type=int, default=0,
                    help="fuse Sarathi-style chunked prefill into the "
@@ -117,7 +127,8 @@ async def _amain(args) -> None:
     sched = Scheduler(eng, max_queue=args.max_queue,
                       default_deadline_s=args.deadline_s)
     app = ServeApp(sched, host=args.host, port=args.port, encoder=encoder,
-                   default_max_tokens=args.max_tokens_default)
+                   default_max_tokens=args.max_tokens_default,
+                   request_timeout_s=args.request_timeout_s)
     await sched.start()
     await app.start()
     print(f"serving on http://{args.host}:{app.port} "
@@ -140,6 +151,11 @@ async def _amain(args) -> None:
 
 def main(argv=None) -> None:
     args = build_args(argv)
+    if args.cpu:
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass  # backend already initialized as cpu
     try:
         asyncio.run(_amain(args))
     except KeyboardInterrupt:

@@ -238,3 +238,107 @@ class ServeMetrics:
                     gauges[name] = None
             out["gauges"] = gauges
         return out
+
+
+class RouterMetrics:
+    """The router tier's registry (serve/router.py): client-visible
+    latency histograms plus the fault-tolerance ledger — per-replica
+    dispatch counts, failovers (a live stream re-driven after its
+    replica died mid-decode), retries (a request re-dispatched before
+    its first token), replica down/up transitions, and explicit shed by
+    cause. The invariant the fault-injection harness asserts lives
+    here: every submitted request is completed + shed (nothing silently
+    failed)."""
+
+    COUNTERS = ("submitted", "dispatched", "completed", "shed",
+                "tokens_out", "failovers", "retries", "replica_down",
+                "replica_up", "replayed_tokens")
+
+    def __init__(self):
+        self._gauges: dict[str, tuple[Callable[[], float], str]] = {}
+        self.ttft = Histogram(
+            "router_ttft_seconds",
+            "submit to first streamed token through the router (includes "
+            "any retry/failover re-dispatch)")
+        self.itl = Histogram(
+            "router_itl_seconds",
+            "inter-token latency at the router's client edge (a failover "
+            "gap shows up as one inflated sample)")
+        self.e2e = Histogram("router_e2e_seconds", "submit to done")
+        self.counters = dict.fromkeys(self.COUNTERS, 0)
+        self.shed_counts: dict[str, int] = {}        # cause -> n
+        self.dispatch_counts: dict[str, int] = {}    # replica -> n
+
+    def inc(self, name: str, n: int = 1) -> None:
+        self.counters[name] += n
+
+    def shed(self, cause: str) -> None:
+        self.counters["shed"] += 1
+        self.shed_counts[cause] = self.shed_counts.get(cause, 0) + 1
+
+    def dispatched(self, replica: str) -> None:
+        self.counters["dispatched"] += 1
+        self.dispatch_counts[replica] = \
+            self.dispatch_counts.get(replica, 0) + 1
+
+    def register_gauge(self, name: str, fn: Callable[[], float],
+                       help_: str = "") -> None:
+        self._gauges[name] = (fn, help_)
+
+    def render_prometheus(self) -> str:
+        lines: list[str] = []
+        for h in (self.ttft, self.itl, self.e2e):
+            lines += h.render()
+        lines += ["# HELP router_requests_total router request lifecycle",
+                  "# TYPE router_requests_total counter"]
+        for name in ("submitted", "dispatched", "completed", "shed",
+                     "failovers", "retries"):
+            lines.append(f'router_requests_total{{event="{name}"}} '
+                         f'{self.counters[name]}')
+        for cause, n in sorted(self.shed_counts.items()):
+            lines.append(f'router_shed_total{{cause="{cause}"}} {n}')
+        for rep, n in sorted(self.dispatch_counts.items()):
+            lines.append(f'router_dispatch_total{{replica="{rep}"}} {n}')
+        lines += ["# HELP router_replica_transitions_total failure-"
+                  "detector state transitions",
+                  "# TYPE router_replica_transitions_total counter",
+                  f'router_replica_transitions_total{{to="down"}} '
+                  f"{self.counters['replica_down']}",
+                  f'router_replica_transitions_total{{to="up"}} '
+                  f"{self.counters['replica_up']}",
+                  "# HELP router_tokens_streamed_total tokens relayed "
+                  "to clients (replayed_tokens excluded — duplicate-"
+                  "suppressed on failover)",
+                  "# TYPE router_tokens_streamed_total counter",
+                  f"router_tokens_streamed_total "
+                  f"{self.counters['tokens_out']}",
+                  f"router_tokens_replayed_total "
+                  f"{self.counters['replayed_tokens']}"]
+        for name, (fn, help_) in sorted(self._gauges.items()):
+            if help_:
+                lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} gauge")
+            try:
+                lines.append(f"{name} {float(fn())}")
+            except Exception:  # pragma: no cover — gauge died
+                lines.append(f"{name} NaN")
+        return "\n".join(lines) + "\n"
+
+    def summary(self) -> dict:
+        """Flat dict for the bench serve_load_router leg / harness JSON."""
+        out = {"ttft": self.ttft.summary(), "itl": self.itl.summary(),
+               "e2e": self.e2e.summary()}
+        out.update(self.counters)
+        if self.shed_counts:
+            out["shed_by_cause"] = dict(self.shed_counts)
+        if self.dispatch_counts:
+            out["dispatch_by_replica"] = dict(self.dispatch_counts)
+        if self._gauges:
+            gauges = {}
+            for name, (fn, _) in sorted(self._gauges.items()):
+                try:
+                    gauges[name] = round(float(fn()), 4)
+                except Exception:  # pragma: no cover — gauge died
+                    gauges[name] = None
+            out["gauges"] = gauges
+        return out

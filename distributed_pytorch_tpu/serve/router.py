@@ -1,0 +1,839 @@
+"""Fault-tolerant router tier over N replica serve/ processes — the
+scale-out half of the ROADMAP's "replicated engines behind a router".
+
+One DecodeEngine saturates one chip's HBM bandwidth; it is also a single
+point of failure — a dead process used to take every in-flight stream
+with it, undetected. This module fronts N self-contained replicas (each
+a scheduler/server pair from this package, typically its own process on
+its own chip) with:
+
+* **Least-loaded dispatch**: each health probe returns the replica's
+  queue-depth / live-slot gauges (the same numbers `/metrics` exports);
+  the pick adds the router's own in-flight count per replica (covering
+  probe staleness) and takes the minimum, so a slow or backed-up
+  replica sheds load to its peers instead of growing a private queue.
+* **Health gating + failure detector**: a periodic `/healthz` probe per
+  replica (readiness, not liveness — a replica whose step loop died or
+  that is draining answers 503 and stops receiving traffic within one
+  probe interval) combined with in-band error counting — a transport
+  failure on a real request marks the replica down IMMEDIATELY, no
+  probe needed. A down replica is re-probed under exponential backoff
+  (base doubling to a cap) and rejoins the pool on the first healthy
+  answer, so a kill-and-restart cycle needs no router restart and no
+  config change.
+* **Per-request failover**: greedy decode is deterministic, so a stream
+  whose replica dies mid-decode is RESUMABLE: the router re-issues the
+  request to a healthy replica with `prompt + tokens_streamed_so_far`
+  as the prompt and the already-streamed count as the budget offset.
+  The replacement replica continues exactly where the dead one stopped
+  (same engine semantics as the scheduler's preemption-resume — and a
+  prefix-cache hit when the replica has seen the prefix), so the client
+  observes ONE gapless, duplicate-free stream, bit-identical to an
+  uninterrupted run (tests/test_router.py pins this).
+* **Bounded retry budget**: each request may be re-dispatched at most
+  `retry_budget` times (failover, replica shed, connect failure all
+  count). Past the budget — or with no healthy replica at all — the
+  router sheds EXPLICITLY (`ShedError` -> HTTP 429/503 with a cause),
+  never a silent drop or a hang: the fault-injection harness asserts
+  completed + shed == submitted.
+* **Draining restarts**: `drain(replica)` forwards `POST /admin/drain`
+  — the replica stops admission (its scheduler sheds new submits, queued
+  requests reach slots, live streams retire) and its healthz flips 503,
+  so traffic hands over to the survivors with zero in-flight loss. Poll
+  the replica's healthz for `drained: true`, then replace the process;
+  the restarted replica rejoins through the failure detector.
+
+stdlib-asyncio only, like server.py. Run it as a process:
+`python -m distributed_pytorch_tpu.serve.router --port 8000
+--replicas 127.0.0.1:8001,127.0.0.1:8002,127.0.0.1:8003`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import AsyncIterator, Optional
+
+from distributed_pytorch_tpu.serve.metrics import RouterMetrics
+from distributed_pytorch_tpu.serve.scheduler import ShedError
+from distributed_pytorch_tpu.serve.server import (_json_response,
+                                                  _response)
+
+
+class ReplicaConnError(RuntimeError):
+    """Transport-level failure against a replica (refused / reset / EOF
+    mid-stream / timeout): the in-band failure-detector signal, and the
+    trigger for per-request failover."""
+
+
+class ReplicaShed(RuntimeError):
+    """The replica explicitly refused the request (429/503 at submit, or
+    an SSE error event mid-queue): carries the upstream cause so the
+    router can decide retry-elsewhere vs propagate."""
+
+    def __init__(self, cause: str, msg: str):
+        super().__init__(msg)
+        self.cause = cause
+
+
+class NoReplica(RuntimeError):
+    """No dispatchable replica (outside the current exclusion set)."""
+
+
+def _parse_addr(url: str) -> tuple[str, int]:
+    url = url.strip()
+    if "//" in url:                       # tolerate http://host:port[/...]
+        url = url.split("//", 1)[1]
+    url = url.split("/", 1)[0]
+    host, _, port = url.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+class Replica:
+    """Router-side view of one replica: address, failure-detector state,
+    and the load gauges the least-loaded pick reads."""
+
+    #: state machine: init -(probe ok)-> healthy -(fails)-> down
+    #: -(backoff probe ok)-> healthy; healthy -(503 draining)-> draining.
+    #: Only 'healthy' is dispatchable.
+    def __init__(self, addr: str):
+        self.host, self.port = _parse_addr(addr)
+        self.name = f"{self.host}:{self.port}"
+        self.state = "init"
+        self.fails = 0                 # consecutive probe failures
+        self.down_streak = 0           # consecutive down-state probes
+        self.next_probe_at = 0.0       # backoff gate while down
+        self.inflight = 0              # router-side dispatched, unfinished
+        self.queue_depth = 0
+        self.live_slots = 0
+        self.n_slots = 0
+        self.last_err: Optional[str] = None
+
+    @property
+    def dispatchable(self) -> bool:
+        return self.state == "healthy"
+
+    @property
+    def load(self) -> int:
+        """Least-loaded score: replica-reported queue + live slots (from
+        the last probe) plus the router's own unacknowledged in-flight
+        count — the in-flight term keeps a burst between two probes from
+        piling onto one replica."""
+        return self.queue_depth + self.live_slots + self.inflight
+
+    def snapshot(self) -> dict:
+        return {"state": self.state, "load": self.load,
+                "queue_depth": self.queue_depth,
+                "live_slots": self.live_slots, "inflight": self.inflight,
+                "fails": self.fails, "last_err": self.last_err}
+
+
+class Router:
+    """Health-gated least-loaded dispatcher with per-request failover.
+
+    >>> router = Router(["127.0.0.1:8001", "127.0.0.1:8002"])
+    >>> await router.start()           # probes once before returning
+    >>> async for ev in router.stream([1, 2, 3], 32): ...
+    >>> await router.stop()
+    """
+
+    def __init__(self, replicas, *, probe_interval_s: float = 0.25,
+                 probe_timeout_s: float = 2.0, fail_threshold: int = 2,
+                 backoff_base_s: float = 0.5, backoff_cap_s: float = 8.0,
+                 retry_budget: int = 3, connect_timeout_s: float = 2.0,
+                 stream_idle_timeout_s: Optional[float] = None,
+                 metrics: Optional[RouterMetrics] = None):
+        self.replicas: dict[str, Replica] = {}
+        for addr in replicas:
+            rep = Replica(addr)
+            self.replicas[rep.name] = rep
+        self.probe_interval_s = probe_interval_s
+        self.probe_timeout_s = probe_timeout_s
+        self.fail_threshold = fail_threshold
+        self.backoff_base_s = backoff_base_s
+        self.backoff_cap_s = backoff_cap_s
+        self.retry_budget = retry_budget
+        self.connect_timeout_s = connect_timeout_s
+        self.stream_idle_timeout_s = stream_idle_timeout_s
+        self.metrics = metrics if metrics is not None else RouterMetrics()
+        self.metrics.register_gauge(
+            "router_healthy_replicas",
+            lambda: sum(r.dispatchable for r in self.replicas.values()),
+            "replicas currently receiving traffic")
+        self.metrics.register_gauge(
+            "router_inflight_requests",
+            lambda: sum(r.inflight for r in self.replicas.values()),
+            "requests dispatched and not yet finished")
+        self._probe_task: Optional[asyncio.Task] = None
+        self._rr = 0                   # round-robin tiebreak cursor
+
+    # ------------------------------------------------------------------
+    # lifecycle / membership
+    # ------------------------------------------------------------------
+
+    async def start(self) -> None:
+        """Probe every replica once (so the first pick sees real states),
+        then start the periodic prober."""
+        await self.probe_all()
+        self._probe_task = asyncio.create_task(self._probe_loop(),
+                                               name="router-prober")
+
+    async def stop(self) -> None:
+        if self._probe_task is not None:
+            self._probe_task.cancel()
+            try:
+                await self._probe_task
+            except asyncio.CancelledError:
+                pass
+            self._probe_task = None
+
+    def add_replica(self, addr: str) -> Replica:
+        """Register a replica at runtime (state 'init' until its first
+        probe — the next probe round picks it up within one interval)."""
+        rep = Replica(addr)
+        self.replicas.setdefault(rep.name, rep)
+        return self.replicas[rep.name]
+
+    def remove_replica(self, addr: str) -> bool:
+        rep = Replica(addr)               # normalize the address
+        return self.replicas.pop(rep.name, None) is not None
+
+    async def drain(self, addr: str) -> dict:
+        """Forward `POST /admin/drain` to the replica and gate it out of
+        dispatch immediately (its own healthz flips 503 too, so the state
+        survives a router restart)."""
+        rep = self.replicas[Replica(addr).name]
+        status, body = await self._admin_post(rep, "/admin/drain")
+        if status == 200:
+            rep.state = "draining"
+        return {"status": status, **body}
+
+    # ------------------------------------------------------------------
+    # failure detector
+    # ------------------------------------------------------------------
+
+    async def _probe_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.probe_interval_s)
+            try:
+                await self.probe_all()
+            except Exception:          # pragma: no cover — prober must
+                pass                   # never die to a stray error
+
+    async def probe_all(self) -> None:
+        reps = list(self.replicas.values())
+        if reps:
+            await asyncio.gather(*(self._probe_one(r) for r in reps))
+
+    async def _probe_one(self, rep: Replica) -> None:
+        now = time.perf_counter()
+        if rep.state == "down" and now < rep.next_probe_at:
+            return                     # exponential backoff: not yet
+        try:
+            status, body = await self._http_json(
+                rep, "GET", "/healthz", timeout=self.probe_timeout_s)
+        except (ConnectionError, OSError, asyncio.TimeoutError,
+                asyncio.IncompleteReadError, ValueError) as e:
+            self._note_failure(rep, f"probe: {e!r}")
+            return
+        rep.queue_depth = int(body.get("queue_depth", 0))
+        rep.live_slots = int(body.get("live_slots", 0))
+        rep.n_slots = int(body.get("n_slots", 0))
+        if status == 200:
+            if rep.state != "healthy":
+                self.metrics.inc("replica_up")
+            rep.state = "healthy"
+            rep.fails = 0
+            rep.down_streak = 0
+            rep.last_err = None
+        elif body.get("draining"):
+            # alive but refusing admission: gate out of dispatch without
+            # the down-state backoff (a drain is deliberate, not a fault)
+            rep.state = "draining"
+            rep.fails = 0
+        else:                          # 503 failed/not-started: a fault
+            self._note_failure(rep, body.get("failed") or f"http {status}")
+
+    def _note_failure(self, rep: Replica, err: str,
+                      in_band: bool = False) -> None:
+        """Count a failure; trip to 'down' past the threshold (in-band
+        errors trip IMMEDIATELY — a request actually failed there, so no
+        more traffic until a probe succeeds) with exponentially backed-
+        off re-probes."""
+        rep.last_err = err
+        rep.fails += 1
+        if not in_band and rep.state == "down":
+            rep.down_streak += 1       # failed re-probe: back off harder
+        if in_band or rep.fails >= self.fail_threshold \
+                or rep.state == "down":
+            if rep.state != "down":
+                self.metrics.inc("replica_down")
+            rep.state = "down"
+            backoff = min(self.backoff_cap_s,
+                          self.backoff_base_s * (2 ** rep.down_streak))
+            rep.next_probe_at = time.perf_counter() + backoff
+
+    # ------------------------------------------------------------------
+    # dispatch
+    # ------------------------------------------------------------------
+
+    def pick(self, exclude: Optional[set] = None) -> Replica:
+        """Least-loaded healthy replica outside `exclude`; round-robin
+        across ties so equal-load replicas share arrivals."""
+        pool = [r for r in self.replicas.values()
+                if r.dispatchable and (not exclude or r.name not in exclude)]
+        if not pool:
+            raise NoReplica("no healthy replica"
+                            + (" outside the tried set" if exclude else ""))
+        best = min(r.load for r in pool)
+        ties = [r for r in pool if r.load == best]
+        self._rr += 1
+        return ties[self._rr % len(ties)]
+
+    async def stream(self, prompt: list, max_tokens: int, *,
+                     deadline_s: Optional[float] = None) \
+            -> AsyncIterator[dict]:
+        """The router's request path: yields `{"token": id}` events and
+        one final `{"done": ..., "reason": ..., "n_tokens": ...,
+        "failovers": ...}`. Raises `ShedError` (with a cause) when the
+        request cannot be served — after the retry budget, or with no
+        healthy replica. On a mid-stream replica death the stream
+        CONTINUES from a healthy replica at the exact token offset; the
+        consumer sees nothing but one longer inter-token gap."""
+        t_submit = time.perf_counter()
+        self.metrics.inc("submitted")
+        got: list[int] = []
+        attempts = 0
+        tried: set[str] = set()
+        last_tok_at: Optional[float] = None
+        last_cause, last_msg = "no_replica", "no healthy replica"
+        while True:
+            try:
+                rep = self.pick(exclude=tried)
+            except NoReplica:
+                self.metrics.shed(last_cause)
+                raise ShedError(last_cause, last_msg) from None
+            self.metrics.dispatched(rep.name)
+            rep.inflight += 1
+            # failover offset: everything already streamed becomes
+            # prompt (greedy decode is deterministic, so the resumed
+            # stream is bit-identical to an uninterrupted one) and the
+            # budget shrinks by the same count — no token is ever re-sent
+            # to the client, none is skipped.
+            inner = self._stream_once(
+                rep, list(prompt) + got, max_tokens - len(got),
+                # deadline bounds the FIRST dispatch's queue wait only: a
+                # failover already streams, shedding it would be
+                # user-visible loss (same exemption the scheduler gives
+                # preemption resumes)
+                deadline_s=deadline_s if not got else None)
+            try:
+                async for ev in inner:
+                    if "token" in ev:
+                        got.append(ev["token"])
+                        now = time.perf_counter()
+                        if len(got) == 1:
+                            self.metrics.ttft.observe(now - t_submit)
+                        elif last_tok_at is not None:
+                            self.metrics.itl.observe(now - last_tok_at)
+                        last_tok_at = now
+                        self.metrics.inc("tokens_out")
+                        tried.clear()     # progress: all replicas back in
+                        yield ev
+                    elif "done" in ev:
+                        self.metrics.inc("completed")
+                        self.metrics.e2e.observe(
+                            time.perf_counter() - t_submit)
+                        yield {"done": True,
+                               "reason": ev.get("reason"),
+                               "n_tokens": len(got),
+                               "failovers": attempts}
+                        return
+            except ReplicaShed as e:
+                if e.cause == "deadline":
+                    # the request's own SLO expired in a replica queue —
+                    # that is the client's explicit backpressure signal,
+                    # not a replica fault; propagate, don't retry
+                    self.metrics.shed("deadline")
+                    raise ShedError("deadline", str(e)) from None
+                last_cause, last_msg = e.cause, str(e)
+                attempts += 1
+                tried.add(rep.name)
+                if attempts > self.retry_budget:
+                    self.metrics.shed("retries_exhausted")
+                    raise ShedError(
+                        "retries_exhausted",
+                        f"{attempts} dispatch attempts failed "
+                        f"(last: {e.cause})") from None
+                self.metrics.inc("retries")
+                continue
+            except (ReplicaConnError, ConnectionError, OSError,
+                    asyncio.TimeoutError, asyncio.IncompleteReadError) \
+                    as e:
+                # in-band detection: the replica died under a real
+                # request — down NOW, probe brings it back later
+                self._note_failure(rep, f"in-band: {e!r}", in_band=True)
+                last_cause = "replica_failure"
+                last_msg = f"replica {rep.name} failed: {e!r}"
+                attempts += 1
+                tried.add(rep.name)
+                if attempts > self.retry_budget:
+                    self.metrics.shed("retries_exhausted")
+                    raise ShedError(
+                        "retries_exhausted",
+                        f"{attempts} dispatch attempts failed (last: "
+                        f"{rep.name} {e!r})") from None
+                if got:
+                    self.metrics.inc("failovers")
+                    self.metrics.inc("replayed_tokens", len(got))
+                else:
+                    self.metrics.inc("retries")
+                if max_tokens - len(got) <= 0:
+                    # died between the last budgeted token and its done
+                    # event: the stream is already complete
+                    self.metrics.inc("completed")
+                    self.metrics.e2e.observe(time.perf_counter() - t_submit)
+                    yield {"done": True, "reason": "budget",
+                           "n_tokens": len(got), "failovers": attempts}
+                    return
+                continue
+            finally:
+                rep.inflight -= 1
+                # close the upstream socket NOW (an abandoned client
+                # stream must free the replica's slot via its disconnect
+                # watch, not wait for GC finalization)
+                try:
+                    await inner.aclose()
+                except Exception:      # pragma: no cover — already dead
+                    pass
+
+    async def complete(self, prompt: list, max_tokens: int, *,
+                       deadline_s: Optional[float] = None) -> dict:
+        """Non-streaming collect: returns {tokens, reason, failovers}."""
+        tokens: list[int] = []
+        done: dict = {}
+        async for ev in self.stream(prompt, max_tokens,
+                                    deadline_s=deadline_s):
+            if "token" in ev:
+                tokens.append(ev["token"])
+            else:
+                done = ev
+        return {"tokens": tokens, "reason": done.get("reason"),
+                "failovers": done.get("failovers", 0)}
+
+    # ------------------------------------------------------------------
+    # replica HTTP client (stdlib asyncio, mirrors the server's framing)
+    # ------------------------------------------------------------------
+
+    async def _connect(self, rep: Replica, timeout: float):
+        return await asyncio.wait_for(
+            asyncio.open_connection(rep.host, rep.port), timeout)
+
+    async def _http_json(self, rep: Replica, method: str, path: str,
+                         body: Optional[dict] = None,
+                         timeout: float = 5.0) -> tuple[int, dict]:
+        reader, writer = await self._connect(rep, timeout)
+        try:
+            payload = json.dumps(body).encode() if body is not None else b""
+            writer.write(
+                (f"{method} {path} HTTP/1.1\r\nHost: {rep.name}\r\n"
+                 f"Content-Length: {len(payload)}\r\n\r\n").encode()
+                + payload)
+            await writer.drain()
+            raw = await asyncio.wait_for(reader.read(), timeout)
+        finally:
+            writer.close()
+        head, _, data = raw.partition(b"\r\n\r\n")
+        status = int(head.split(b" ")[1])
+        try:
+            return status, json.loads(data or b"{}")
+        except json.JSONDecodeError:
+            return status, {}
+
+    async def _admin_post(self, rep: Replica, path: str) -> tuple[int,
+                                                                  dict]:
+        return await self._http_json(rep, "POST", path,
+                                     timeout=self.probe_timeout_s)
+
+    async def _stream_once(self, rep: Replica, prompt: list,
+                           max_tokens: int,
+                           deadline_s: Optional[float]) \
+            -> AsyncIterator[dict]:
+        """One dispatch: POST the completion to `rep`, yield its SSE
+        events. Raises ReplicaShed on an explicit upstream refusal and
+        ReplicaConnError/transport errors on anything that smells like a
+        dead replica (EOF before the done event included)."""
+        body: dict = {"prompt": prompt, "max_tokens": max_tokens,
+                      "stream": True}
+        if deadline_s is not None:
+            body["deadline_s"] = deadline_s
+        reader, writer = await self._connect(rep, self.connect_timeout_s)
+        try:
+            payload = json.dumps(body).encode()
+            writer.write(
+                (f"POST /v1/completions HTTP/1.1\r\nHost: {rep.name}\r\n"
+                 f"Content-Length: {len(payload)}\r\n\r\n").encode()
+                + payload)
+            await writer.drain()
+            status_line = await self._read_line(reader)
+            status = int(status_line.split(b" ")[1])
+            while (await self._read_line(reader)).strip():
+                pass                                   # drain headers
+            if status != 200:
+                data = await reader.read()
+                try:
+                    err = json.loads(
+                        data.partition(b"\r\n\r\n")[0] or data or b"{}")
+                except json.JSONDecodeError:
+                    err = {}
+                raise ReplicaShed(err.get("cause", f"http_{status}"),
+                                  err.get("error", f"replica returned "
+                                                   f"{status}"))
+            while True:
+                line = (await self._read_line(reader)).strip()
+                if not line:
+                    continue
+                if not line.startswith(b"data: "):
+                    raise ReplicaConnError(f"bad SSE line {line[:60]!r}")
+                payload = line[len(b"data: "):]
+                if payload == b"[DONE]":
+                    return
+                ev = json.loads(payload)
+                if "error" in ev:
+                    cause = ev.get("cause", "internal")
+                    if cause in ("engine_error", "shutdown", "internal"):
+                        # the replica is dying mid-request: treat like a
+                        # transport death so the stream fails over
+                        raise ReplicaConnError(
+                            f"replica error event: {cause}")
+                    raise ReplicaShed(cause, ev["error"])
+                yield ev
+                if "done" in ev:
+                    return
+        finally:
+            writer.close()
+
+    async def _read_line(self, reader: asyncio.StreamReader) -> bytes:
+        line = await (asyncio.wait_for(reader.readline(),
+                                       self.stream_idle_timeout_s)
+                      if self.stream_idle_timeout_s else reader.readline())
+        if line == b"":                # EOF mid-protocol = dead replica
+            raise ReplicaConnError("connection closed mid-stream")
+        return line
+
+    # ------------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        return {name: rep.snapshot()
+                for name, rep in sorted(self.replicas.items())}
+
+
+class RouterApp:
+    """Bind a `Router` to an HTTP port: the same `/v1/completions`
+    surface the replicas expose (clients need no code change to move
+    behind the router), plus the admin plane the fault-injection harness
+    drives.
+
+    Endpoints: POST /v1/completions (SSE or JSON), GET /healthz (200
+    while >= 1 replica is dispatchable), GET /metrics, GET
+    /admin/replicas, POST /admin/drain {"replica": addr}, POST
+    /admin/add_replica {"url": addr}, POST /admin/remove_replica."""
+
+    def __init__(self, router: Router, *, host: str = "127.0.0.1",
+                 port: int = 8000, default_max_tokens: int = 64,
+                 request_timeout_s: float = 30.0):
+        self.router = router
+        self.host = host
+        self.port = port
+        self.default_max_tokens = default_max_tokens
+        self.request_timeout_s = request_timeout_s
+        self._server: Optional[asyncio.base_events.Server] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def serve_forever(self) -> None:
+        assert self._server is not None, "call start() first"
+        async with self._server:
+            await self._server.serve_forever()
+
+    # ------------------------------------------------------------------
+
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        try:
+            head = await asyncio.wait_for(reader.readuntil(b"\r\n\r\n"),
+                                          self.request_timeout_s)
+        except asyncio.TimeoutError:
+            try:
+                writer.write(_json_response(
+                    408, {"error": "timed out reading request"}))
+                await writer.drain()
+            except (ConnectionError, asyncio.CancelledError):
+                pass
+            finally:
+                writer.close()
+            return
+        except (asyncio.IncompleteReadError, asyncio.LimitOverrunError,
+                ConnectionError):
+            writer.close()
+            return
+        try:
+            request_line, *header_lines = head.decode(
+                "latin-1").split("\r\n")
+            parts = request_line.split(" ")
+            if len(parts) < 2:
+                writer.write(_json_response(400, {"error": "bad request"}))
+                return
+            method, path = parts[0].upper(), parts[1].split("?")[0]
+            headers = {}
+            for line in header_lines:
+                if ":" in line:
+                    k, v = line.split(":", 1)
+                    headers[k.strip().lower()] = v.strip()
+            if method == "GET" and path == "/healthz":
+                n_up = sum(r.dispatchable
+                           for r in self.router.replicas.values())
+                writer.write(_json_response(
+                    200 if n_up else 503,
+                    {"ok": n_up > 0, "healthy_replicas": n_up,
+                     "replicas": self.router.snapshot()}))
+            elif method == "GET" and path == "/metrics":
+                body = self.router.metrics.render_prometheus().encode()
+                writer.write(_response(
+                    200, body, "text/plain; version=0.0.4; charset=utf-8"))
+            elif method == "GET" and path == "/admin/replicas":
+                writer.write(_json_response(200, self.router.snapshot()))
+            elif method == "POST" and path == "/v1/completions":
+                await self._completions(reader, writer, headers)
+            elif method == "POST" and path in ("/admin/drain",
+                                               "/admin/add_replica",
+                                               "/admin/remove_replica"):
+                await self._admin(reader, writer, headers, path)
+            elif path in ("/healthz", "/metrics", "/v1/completions",
+                          "/admin/replicas", "/admin/drain",
+                          "/admin/add_replica", "/admin/remove_replica"):
+                writer.write(_json_response(405, {"error": "method not "
+                                                           "allowed"}))
+            else:
+                writer.write(_json_response(404, {"error": "not found"}))
+            await writer.drain()
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            writer.close()
+
+    async def _read_body(self, reader, writer, headers) -> Optional[dict]:
+        try:
+            n = int(headers.get("content-length", "0"))
+        except ValueError:
+            writer.write(_json_response(400, {"error": "bad "
+                                                       "content-length"}))
+            return None
+        try:
+            raw = await asyncio.wait_for(reader.readexactly(n),
+                                         self.request_timeout_s)
+            return json.loads(raw or b"{}")
+        except asyncio.TimeoutError:
+            writer.write(_json_response(
+                408, {"error": "timed out reading request body"}))
+            return None
+        except (json.JSONDecodeError, asyncio.IncompleteReadError):
+            writer.write(_json_response(400, {"error": "invalid JSON "
+                                                       "body"}))
+            return None
+
+    async def _admin(self, reader, writer, headers, path) -> None:
+        body = await self._read_body(reader, writer, headers)
+        if body is None:
+            return
+        addr = body.get("replica") or body.get("url")
+        if not addr:
+            writer.write(_json_response(
+                400, {"error": "need 'replica' (or 'url') address"}))
+            return
+        if path == "/admin/drain":
+            try:
+                out = await self.router.drain(addr)
+            except KeyError:
+                writer.write(_json_response(404, {"error": f"unknown "
+                                                           f"replica "
+                                                           f"{addr}"}))
+                return
+            except (ConnectionError, OSError, asyncio.TimeoutError) as e:
+                writer.write(_json_response(
+                    503, {"error": f"drain failed: {e!r}"}))
+                return
+            writer.write(_json_response(200, out))
+        elif path == "/admin/add_replica":
+            rep = self.router.add_replica(addr)
+            writer.write(_json_response(200, {rep.name: rep.snapshot()}))
+        else:
+            removed = self.router.remove_replica(addr)
+            writer.write(_json_response(200 if removed else 404,
+                                        {"removed": removed}))
+
+    async def _completions(self, reader, writer, headers) -> None:
+        body = await self._read_body(reader, writer, headers)
+        if body is None:
+            return
+        prompt = body.get("prompt")
+        if not isinstance(prompt, list) or not prompt \
+                or not all(isinstance(t, int) for t in prompt):
+            writer.write(_json_response(
+                400, {"error": "'prompt' must be a non-empty list of "
+                               "token ids"}))
+            return
+        max_tokens = int(body.get("max_tokens", self.default_max_tokens))
+        if max_tokens < 1:
+            writer.write(_json_response(400, {"error": "max_tokens must "
+                                                       "be >= 1"}))
+            return
+        deadline = body.get("deadline_s")
+        deadline = float(deadline) if deadline is not None else None
+        if bool(body.get("stream", True)):
+            await self._stream_sse(reader, writer, prompt, max_tokens,
+                                   deadline)
+            return
+        try:
+            out = await self.router.complete(prompt, max_tokens,
+                                             deadline_s=deadline)
+        except ShedError as e:
+            writer.write(_json_response(
+                429 if e.cause in ("queue_full", "retries_exhausted")
+                else 503, {"error": str(e), "cause": e.cause}))
+            return
+        writer.write(_json_response(200, out))
+
+    async def _stream_sse(self, reader, writer, prompt, max_tokens,
+                          deadline) -> None:
+        agen = self.router.stream(prompt, max_tokens, deadline_s=deadline)
+        # shed BEFORE the first event maps to an HTTP status (the client
+        # has seen nothing yet); after that it becomes an SSE error event
+        try:
+            first = await agen.__anext__()
+        except ShedError as e:
+            writer.write(_json_response(
+                429 if e.cause in ("queue_full", "retries_exhausted")
+                else 503, {"error": str(e), "cause": e.cause}))
+            return
+        except StopAsyncIteration:     # pragma: no cover — can't happen
+            writer.write(_json_response(500, {"error": "empty stream"}))
+            return
+        writer.write(b"HTTP/1.1 200 OK\r\n"
+                     b"Content-Type: text/event-stream\r\n"
+                     b"Cache-Control: no-cache\r\n"
+                     b"Connection: close\r\n\r\n")
+        eof_task = asyncio.ensure_future(reader.read(1))
+        next_ev: Optional[asyncio.Future] = None
+        try:
+            ev = first
+            while True:
+                writer.write(self._sse(ev))
+                await writer.drain()
+                if "done" in ev:
+                    writer.write(b"data: [DONE]\n\n")
+                    await writer.drain()
+                    return
+                next_ev = asyncio.ensure_future(agen.__anext__())
+                done, _ = await asyncio.wait(
+                    {next_ev, eof_task},
+                    return_when=asyncio.FIRST_COMPLETED)
+                if eof_task in done:   # client gone: abandon upstream
+                    next_ev.cancel()   # (closing it cancels the slot)
+                    return
+                try:
+                    ev = next_ev.result()
+                except StopAsyncIteration:
+                    return
+                except ShedError as e:
+                    writer.write(self._sse({"error": str(e),
+                                            "cause": e.cause}))
+                    await writer.drain()
+                    return
+        except (ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            eof_task.cancel()
+            if next_ev is not None:
+                next_ev.cancel()
+            try:
+                await agen.aclose()
+            except Exception:          # pragma: no cover — already dead
+                pass
+
+    @staticmethod
+    def _sse(obj: dict) -> bytes:
+        return f"data: {json.dumps(obj)}\n\n".encode()
+
+
+# ----------------------------------------------------------------------
+# CLI: `python -m distributed_pytorch_tpu.serve.router`
+# ----------------------------------------------------------------------
+
+def build_args(argv=None):
+    import argparse
+    p = argparse.ArgumentParser(
+        description="Fault-tolerant router over N serve/ replicas")
+    p.add_argument("--replicas", type=str, required=True,
+                   help="comma-separated replica addresses "
+                        "(host:port,host:port,...)")
+    p.add_argument("--host", type=str, default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8000,
+                   help="0 picks an ephemeral port (printed at startup)")
+    p.add_argument("--probe-interval-s", type=float, default=0.25)
+    p.add_argument("--fail-threshold", type=int, default=2,
+                   help="consecutive probe failures before a replica is "
+                        "marked down (in-band request failures trip "
+                        "immediately)")
+    p.add_argument("--backoff-base-s", type=float, default=0.5)
+    p.add_argument("--backoff-cap-s", type=float, default=8.0)
+    p.add_argument("--retry-budget", type=int, default=3,
+                   help="max re-dispatches per request before an "
+                        "explicit shed")
+    p.add_argument("--max-tokens-default", type=int, default=64)
+    return p.parse_args(argv)
+
+
+async def _amain(args) -> None:
+    router = Router([a for a in args.replicas.split(",") if a.strip()],
+                    probe_interval_s=args.probe_interval_s,
+                    fail_threshold=args.fail_threshold,
+                    backoff_base_s=args.backoff_base_s,
+                    backoff_cap_s=args.backoff_cap_s,
+                    retry_budget=args.retry_budget)
+    app = RouterApp(router, host=args.host, port=args.port,
+                    default_max_tokens=args.max_tokens_default)
+    await router.start()
+    await app.start()
+    up = sum(r.dispatchable for r in router.replicas.values())
+    print(f"routing on http://{args.host}:{app.port} over "
+          f"{len(router.replicas)} replicas ({up} healthy), "
+          f"retry_budget={args.retry_budget}")
+    try:
+        await app.serve_forever()
+    except (KeyboardInterrupt, asyncio.CancelledError):
+        pass
+    finally:
+        await app.stop()
+        await router.stop()
+
+
+def main(argv=None) -> None:
+    try:
+        asyncio.run(_amain(build_args(argv)))
+    except KeyboardInterrupt:
+        print("\nrouter shutting down")
+
+
+if __name__ == "__main__":
+    main()

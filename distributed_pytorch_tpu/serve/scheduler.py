@@ -47,6 +47,14 @@ module gives the host side:
   leaves the request queued until a retirement frees blocks — shed stays
   reserved for admission-bound overflow (queue_full/deadline/shutdown).
 
+* **Fail loud, drain clean** (round 13): an exception escaping the step
+  loop fails EVERY pending handle with an `EngineError` (never a hung
+  stream), flips `healthy` False (`/healthz` -> 503) and sheds all later
+  submits — the health-gated router's signal to fail the replica out and
+  re-drive its streams elsewhere. `drain()` is the graceful half: stop
+  admission (shed cause 'draining'), let queued requests reach slots and
+  live streams retire, then hand the port to a replacement process.
+
 Threading contract: `submit`/`cancel` must be called on the event loop
 (the HTTP server does); only the background loop touches the engine, and
 it serializes admits/steps through the executor, so the engine never sees
@@ -69,11 +77,25 @@ from distributed_pytorch_tpu.serve.metrics import ServeMetrics
 
 class ShedError(RuntimeError):
     """Admission control rejected/evicted the request (queue_full |
-    deadline | shutdown). Surfaces as HTTP 429/503 — never a hang."""
+    deadline | shutdown | draining | engine_error). Surfaces as HTTP
+    429/503 — never a hang."""
 
     def __init__(self, cause: str, msg: str):
         super().__init__(msg)
         self.cause = cause
+
+
+class EngineError(RuntimeError):
+    """The background step loop died: the engine raised, every pending
+    stream is failed with THIS error (never left hanging), `/healthz`
+    flips to 503, and later submits shed — the router's cue to fail the
+    replica out and re-drive its in-flight requests elsewhere."""
+
+    cause = "engine_error"
+
+    def __init__(self, original: BaseException):
+        super().__init__(f"engine step loop died: {original!r}")
+        self.original = original
 
 
 @dataclasses.dataclass
@@ -124,10 +146,12 @@ class RequestHandle:
 
     def _push_done(self, ret: Retired) -> None:
         self.retired = ret
+        self._scheduler._pending.discard(self)
         self._events.put_nowait(("done", ret))
 
     def _push_error(self, exc: BaseException) -> None:
         self.error = exc
+        self._scheduler._pending.discard(self)
         self._events.put_nowait(("error", exc))
 
     # -- caller side ----------------------------------------------------
@@ -190,11 +214,17 @@ class Scheduler:
         self._queue: collections.deque[_Request] = collections.deque()
         self._live: dict[int, _Request] = {}       # seq_id -> request
         self._cancel_live: list[_Request] = []     # applied between steps
+        # EVERY handle that has not yet seen done/error, including those
+        # popped into a wave-local list mid-admission — the crash guard
+        # iterates this, so no stream can hang on a loop death
+        self._pending: set[RequestHandle] = set()
         self._wake = asyncio.Event()
         self._exec = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="decode")
         self._task: Optional[asyncio.Task] = None
         self._stopping = False
+        self._draining = False
+        self._failed: Optional[EngineError] = None
         self.metrics.register_gauge(
             "serve_queue_depth", lambda: len(self._queue),
             "requests waiting for a slot")
@@ -240,8 +270,14 @@ class Scheduler:
         """Enqueue a request (FCFS). Raises `ShedError` immediately when
         the admission queue is at its bound or the scheduler is stopping —
         backpressure is explicit, the caller maps it to HTTP 429/503."""
+        if self._failed is not None:
+            raise ShedError("engine_error", str(self._failed))
         if self._stopping:
             raise ShedError("shutdown", "scheduler is stopping")
+        if self._draining:
+            self.metrics.shed("draining")
+            raise ShedError("draining", "scheduler is draining; no new "
+                                        "admissions (live slots retiring)")
         self.metrics.inc("submitted")
         if len(self._queue) >= self.max_queue:
             self.metrics.shed("queue_full")
@@ -256,9 +292,44 @@ class Scheduler:
                        orig_prompt_len=len(prompt),
                        budget_total=max_new_tokens)
         req.handle = RequestHandle(self, req)
+        self._pending.add(req.handle)
         self._queue.append(req)
         self._wake.set()
         return req.handle
+
+    def drain(self) -> None:
+        """Stop ADMISSION, keep serving: new submits shed with cause
+        'draining' (a health-gating router stops dispatching here the
+        moment `/healthz` flips), already-queued requests still reach
+        slots, and live streams run to retirement. The draining restart
+        recipe: drain -> wait for `drained` -> stop/replace the process —
+        zero in-flight streams lost, unlike a bare stop() whose shutdown
+        path sheds the queue and cancels live slots."""
+        self._draining = True
+        self._wake.set()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    @property
+    def drained(self) -> bool:
+        """True once a drain has fully quiesced (nothing queued or live)."""
+        return self._draining and not self._queue and not self._live
+
+    @property
+    def failed(self) -> Optional[EngineError]:
+        """The step loop's death certificate (None while healthy)."""
+        return self._failed
+
+    @property
+    def healthy(self) -> bool:
+        """Readiness: the background step loop is running and has not
+        died. Draining is reported separately — a draining scheduler is
+        alive but must not receive traffic, so `/healthz` returns 503
+        for either."""
+        return (self._task is not None and not self._task.done()
+                and self._failed is None and not self._stopping)
 
     @property
     def queue_depth(self) -> int:
@@ -540,8 +611,15 @@ class Scheduler:
                 # one cooperative yield so consumers drain between steps
                 await asyncio.sleep(0)
         except Exception as exc:               # crash guard: error, not hang
-            for req in list(self._live.values()) + list(self._queue):
-                req.handle._push_error(exc)
+            # fail EVERY pending handle — not just _live/_queue: a wave
+            # admission pops requests into a loop-local list, and an
+            # exception mid-wave would otherwise strand those streams
+            # forever (the regression tests/test_serve.py pins). The
+            # failure flag flips /healthz to 503 and makes later submits
+            # shed immediately instead of queueing into a dead loop.
+            self._failed = EngineError(exc)
+            for handle in list(self._pending):
+                handle._push_error(self._failed)
             self._live.clear()
             self._queue.clear()
             raise

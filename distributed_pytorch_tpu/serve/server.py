@@ -11,14 +11,26 @@ Endpoints:
   need tiktoken (the prepare scripts' GPT-2 BPE); token-id lists always
   work. Queue-full / deadline shed maps to HTTP 429 — backpressure is an
   explicit status, never a hang.
-* `GET /healthz` — liveness + a queue/slot snapshot.
+* `GET /healthz` — READINESS, not just liveness: 200 with a queue/slot
+  snapshot while serving; **503** when the scheduler's background step
+  loop has died (engine error) or the server is draining. The router
+  tier health-gates dispatch on exactly this signal, so a sick replica
+  stops receiving traffic within one probe interval.
 * `GET /metrics` — Prometheus text exposition (serve/metrics.py).
+* `POST /admin/drain` — draining restart, phase 1: stop admission (new
+  submits shed with cause 'draining', healthz flips 503 so the router
+  hands traffic to the other replicas), let queued requests reach slots
+  and live streams retire. Poll healthz until `drained` is true, then
+  replace the process — zero in-flight streams lost.
 
 Client disconnects matter at decode timescales: a dropped SSE consumer
 must not hold a slot for its remaining budget. The completion handler
 watches the connection's read side concurrently with the token stream —
 EOF (close/reset) cancels the request, and the scheduler frees the slot
-before the next fused step.
+before the next fused step. The read side is also bounded the other way:
+a stalled (slowloris) client that never finishes its request head/body
+would hold a connection slot forever, so parsing runs under a
+per-connection read timeout — 408 and close.
 """
 
 from __future__ import annotations
@@ -37,7 +49,8 @@ _MAX_BODY_BYTES = 8 * 1024 * 1024
 def _response(status: int, body: bytes, content_type: str,
               extra: str = "") -> bytes:
     reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
-              405: "Method Not Allowed", 413: "Payload Too Large",
+              405: "Method Not Allowed", 408: "Request Timeout",
+              413: "Payload Too Large",
               429: "Too Many Requests", 500: "Internal Server Error",
               503: "Service Unavailable"}.get(status, "OK")
     return (f"HTTP/1.1 {status} {reason}\r\n"
@@ -60,13 +73,16 @@ class ServeApp:
 
     def __init__(self, scheduler: Scheduler, *, host: str = "127.0.0.1",
                  port: int = 8000, encoder=None,
-                 default_max_tokens: int = 64):
+                 default_max_tokens: int = 64,
+                 request_timeout_s: float = 30.0):
         self.scheduler = scheduler
         self.host = host
         self.port = port
         self.encoder = encoder            # tiktoken-like, or None (ids only)
         self.default_max_tokens = default_max_tokens
+        self.request_timeout_s = request_timeout_s
         self._server: Optional[asyncio.base_events.Server] = None
+        self._writers: set[asyncio.StreamWriter] = set()
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -79,6 +95,22 @@ class ServeApp:
             await self._server.wait_closed()
             self._server = None
 
+    def abort(self) -> None:
+        """Crash-style teardown: close the listening socket AND rip every
+        open connection's transport out from under its handler — what a
+        SIGKILL does to the process, minus the process. The in-process
+        fault-injection tests use this to make a replica 'die'
+        mid-stream; normal shutdown uses stop(), which leaves streams to
+        finish."""
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+        for w in list(self._writers):
+            try:
+                w.transport.abort()
+            except Exception:
+                pass
+
     async def serve_forever(self) -> None:
         assert self._server is not None, "call start() first"
         async with self._server:
@@ -88,8 +120,29 @@ class ServeApp:
 
     async def _handle_conn(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter) -> None:
+        self._writers.add(writer)
         try:
-            head = await reader.readuntil(b"\r\n\r\n")
+            await self._handle_conn_inner(reader, writer)
+        finally:
+            self._writers.discard(writer)
+
+    async def _handle_conn_inner(self, reader: asyncio.StreamReader,
+                                 writer: asyncio.StreamWriter) -> None:
+        try:
+            # bounded read: a stalled client mid-request-head must not
+            # hold this connection slot forever (slowloris) — 408, close
+            head = await asyncio.wait_for(reader.readuntil(b"\r\n\r\n"),
+                                          self.request_timeout_s)
+        except asyncio.TimeoutError:
+            try:
+                writer.write(_json_response(
+                    408, {"error": "timed out reading request"}))
+                await writer.drain()
+            except (ConnectionError, asyncio.CancelledError):
+                pass
+            finally:
+                writer.close()
+            return
         except (asyncio.IncompleteReadError, asyncio.LimitOverrunError,
                 ConnectionError):
             writer.close()
@@ -120,7 +173,14 @@ class ServeApp:
                     200, body, "text/plain; version=0.0.4; charset=utf-8"))
             elif method == "POST" and path == "/v1/completions":
                 await self._completions(reader, writer, headers)
-            elif path in ("/healthz", "/metrics", "/v1/completions"):
+            elif method == "POST" and path == "/admin/drain":
+                self.scheduler.drain()
+                writer.write(_json_response(200, {
+                    "draining": True, "drained": self.scheduler.drained,
+                    "live_slots": self.scheduler.engine.n_live,
+                    "queue_depth": self.scheduler.queue_depth}))
+            elif path in ("/healthz", "/metrics", "/v1/completions",
+                          "/admin/drain"):
                 writer.write(_json_response(405, {"error": "method not "
                                                            "allowed"}))
             else:
@@ -132,11 +192,27 @@ class ServeApp:
             writer.close()
 
     def _healthz(self) -> bytes:
-        eng = self.scheduler.engine
-        return _json_response(200, {
-            "ok": True, "live_slots": eng.n_live, "free_slots": eng.n_free,
-            "queue_depth": self.scheduler.queue_depth,
-            "n_slots": eng.n_slots})
+        """Readiness probe. 200 only while the step loop is alive and the
+        server is admitting; 503 (with the reason in the body) when the
+        loop died or a drain is in progress — the router tier gates
+        dispatch on exactly this status. The body always carries the
+        load gauges the router's least-loaded pick reads (the same
+        numbers /metrics exports as serve_queue_depth /
+        serve_slot_occupancy), so one probe serves both purposes."""
+        sched = self.scheduler
+        eng = sched.engine
+        ready = sched.healthy and not sched.draining
+        body = {"ok": ready, "live_slots": eng.n_live,
+                "free_slots": eng.n_free,
+                "queue_depth": sched.queue_depth,
+                "n_slots": eng.n_slots,
+                "occupancy": round(eng.occupancy, 4),
+                "draining": sched.draining}
+        if sched.draining:
+            body["drained"] = sched.drained
+        if sched.failed is not None:
+            body["failed"] = str(sched.failed)
+        return _json_response(200 if ready else 503, body)
 
     # ------------------------------------------------------------------
 
@@ -151,7 +227,12 @@ class ServeApp:
             writer.write(_json_response(413, {"error": "body too large"}))
             return
         try:
-            body = json.loads((await reader.readexactly(n)) or b"{}")
+            body = json.loads((await asyncio.wait_for(
+                reader.readexactly(n), self.request_timeout_s)) or b"{}")
+        except asyncio.TimeoutError:
+            writer.write(_json_response(
+                408, {"error": "timed out reading request body"}))
+            return
         except (json.JSONDecodeError, asyncio.IncompleteReadError):
             writer.write(_json_response(400, {"error": "invalid JSON "
                                                        "body"}))
@@ -199,6 +280,11 @@ class ServeApp:
                 writer.write(_json_response(429, {"error": str(e),
                                                   "cause": e.cause}))
                 return
+            except Exception as e:         # engine death: explicit 500
+                writer.write(_json_response(500, {
+                    "error": str(e),
+                    "cause": getattr(e, "cause", "internal")}))
+                return
             writer.write(_json_response(200, {
                 "tokens": ret.tokens[ret.prompt_len:],
                 "text": self._decode(ret.tokens[ret.prompt_len:]),
@@ -240,6 +326,14 @@ class ServeApp:
                 except ShedError as e:
                     writer.write(self._sse({"error": str(e),
                                             "cause": e.cause}))
+                    await writer.drain()
+                    return
+                except (ConnectionError, asyncio.CancelledError):
+                    raise
+                except Exception as e:     # engine death mid-stream: an
+                    writer.write(self._sse({  # explicit event, not a hang
+                        "error": str(e),
+                        "cause": getattr(e, "cause", "internal")}))
                     await writer.drain()
                     return
                 event = {"token": tok}

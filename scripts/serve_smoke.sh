@@ -46,4 +46,17 @@ smoke_one
 echo "=== chunked-prefill smoke (--prefill-chunk 32) ==="
 smoke_one --prefill-chunk 32
 
+# Router tier: 2 real replica processes behind the health-gated router,
+# one SIGKILLed mid-Poisson-drive and replaced on the same port. The
+# harness exits nonzero unless every request completed its full budget
+# bit-identical to offline greedy OR was explicitly shed (zero silent
+# failures), so this leg smoke-proves detection, failover, and rejoin
+# end-to-end. Demo replicas only run with token-id prompts on --demo;
+# the checkpoint variant smokes the single-server path above instead.
+if [ "${SRC_ARGS[0]}" = "--demo" ]; then
+  echo "=== router kill-and-replace smoke (2 replicas) ==="
+  python scripts/fault_inject.py --replicas 2 --requests 12 \
+    --budget-lo 6 --budget-hi 12 --mode kill
+fi
+
 echo "serve smoke OK"

@@ -1,0 +1,530 @@
+"""Fault-tolerant router tier (serve/router.py) over in-process replica
+servers: least-loaded dispatch, health gating with backoff rejoin,
+draining restarts, explicit shed on retry exhaustion, and the core
+failover-idempotency property — a replica killed mid-stream must leave
+the client-observed token sequence gapless, duplicate-free, and
+bit-identical to offline engine greedy.
+
+Replicas here are real ServeApp/Scheduler/DecodeEngine stacks on
+localhost ports; a 'kill' is `ServeApp.abort()` (every open transport
+ripped out, listening socket closed — what SIGKILL does to the process,
+minus the process). Every async body runs under a hard wait_for so a
+routing bug fails fast instead of hanging the suite."""
+
+import asyncio
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_pytorch_tpu.config import LLMConfig
+from distributed_pytorch_tpu.engine import DecodeEngine
+from distributed_pytorch_tpu.models.gpt import LLM
+from distributed_pytorch_tpu.serve.metrics import RouterMetrics
+from distributed_pytorch_tpu.serve.router import (NoReplica, Replica,
+                                                  Router, RouterApp)
+from distributed_pytorch_tpu.serve.scheduler import Scheduler, ShedError
+from distributed_pytorch_tpu.serve.server import ServeApp
+
+
+def tiny_cfg(**kw):
+    base = dict(vocab_size=97, block_size=64, n_embd=48, n_head=4,
+                n_kv_heads=2, attn="gqa", n_layer=2, up_dim=64,
+                non_linearity="swiglu", pos_emb="rope", dropout=0.0)
+    base.update(kw)
+    return LLMConfig(**base)
+
+
+@pytest.fixture(scope="module")
+def mv():
+    cfg = tiny_cfg()
+    model = LLM(cfg, attn_impl="naive")
+    rng = jax.random.PRNGKey(0)
+    x = jnp.zeros((1, cfg.block_size), jnp.int32)
+    variables = dict(model.init({"params": rng, "dropout": rng}, x, x))
+    return cfg, model, variables
+
+
+def run_async(coro, timeout=300):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+class Rep:
+    """One in-process replica: engine + scheduler + HTTP server.
+    `step_delay` throttles the engine so a test can reliably land a kill
+    mid-stream (tiny-model steps are sub-ms otherwise)."""
+
+    def __init__(self, mv, *, port=0, n_slots=2, step_delay=0.0):
+        _, model, variables = mv
+        self.eng = DecodeEngine(model, variables, n_slots=n_slots,
+                                temperature=0.0, min_bucket=8)
+        if step_delay:
+            orig = self.eng.step
+
+            def slow_step():
+                time.sleep(step_delay)
+                return orig()
+
+            self.eng.step = slow_step
+        self.sched = Scheduler(self.eng, max_queue=32)
+        self.app = ServeApp(self.sched, port=port)
+
+    async def start(self):
+        await self.sched.start()
+        await self.app.start()
+        return self
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.app.port}"
+
+    async def kill(self):
+        """Crash, not shutdown: abort every transport, then stop the
+        scheduler so the dead replica's engine stops burning CPU."""
+        self.app.abort()
+        await self.sched.stop()
+
+    async def stop(self):
+        await self.app.stop()
+        await self.sched.stop()
+
+
+def make_router(*reps, **kw):
+    kw.setdefault("probe_interval_s", 0.05)
+    kw.setdefault("probe_timeout_s", 1.0)
+    kw.setdefault("fail_threshold", 2)
+    kw.setdefault("backoff_base_s", 0.05)
+    kw.setdefault("backoff_cap_s", 0.5)
+    kw.setdefault("connect_timeout_s", 1.0)
+    return Router([r.addr if isinstance(r, Rep) else r for r in reps],
+                  **kw)
+
+
+def offline_ref(mv, prompts, budgets):
+    _, model, variables = mv
+    eng = DecodeEngine(model, variables, n_slots=2, temperature=0.0,
+                       min_bucket=8)
+    return eng.run(prompts, budgets)
+
+
+# ----------------------------------------------------------------------
+# minimal SSE client against the RouterApp (HTTP e2e)
+# ----------------------------------------------------------------------
+
+async def http_post(port, path, obj):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    body = json.dumps(obj).encode()
+    writer.write(f"POST {path} HTTP/1.1\r\nHost: t\r\n"
+                 f"Content-Length: {len(body)}\r\n\r\n".encode() + body)
+    await writer.drain()
+    return reader, writer
+
+
+async def http_get(port, path):
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write(f"GET {path} HTTP/1.1\r\nHost: t\r\n\r\n".encode())
+    await writer.drain()
+    data = await reader.read()
+    writer.close()
+    head, _, body = data.partition(b"\r\n\r\n")
+    return int(head.split(b" ")[1]), body.decode()
+
+
+async def read_sse(reader, on_token=None):
+    """Drain one SSE stream: returns (tokens, done_or_error_event).
+    `on_token(i)` fires after the i-th token — the kill hook."""
+    tokens, done = [], None
+    while True:
+        line = (await reader.readline()).decode().strip()
+        if not line:
+            continue
+        assert line.startswith("data: "), line
+        payload = line[len("data: "):]
+        if payload == "[DONE]":
+            break
+        ev = json.loads(payload)
+        if "token" in ev:
+            tokens.append(ev["token"])
+            if on_token is not None:
+                await on_token(len(tokens))
+        else:
+            done = ev
+            if "error" in ev:
+                break
+    return tokens, done
+
+
+# ----------------------------------------------------------------------
+# pick(): pure failure-detector / load logic, no sockets
+# ----------------------------------------------------------------------
+
+def test_pick_least_loaded_and_exclusion():
+    r = Router(["127.0.0.1:1", "127.0.0.1:2", "127.0.0.1:3"])
+    a, b, c = (r.replicas[f"127.0.0.1:{i}"] for i in (1, 2, 3))
+    for rep in (a, b, c):
+        rep.state = "healthy"
+    a.queue_depth, b.queue_depth, c.queue_depth = 3, 1, 2
+    assert r.pick().name == b.name
+    # the router-side inflight term counts toward the score
+    b.inflight = 4
+    assert r.pick().name == c.name
+    # exclusion (the tried set) skips a replica even if least loaded
+    assert r.pick(exclude={c.name}).name == a.name
+    # non-healthy states never dispatch
+    a.state, b.state, c.state = "down", "draining", "init"
+    with pytest.raises(NoReplica):
+        r.pick()
+
+
+def test_replica_addr_parsing():
+    assert (Replica("http://10.0.0.5:8001").host,
+            Replica("http://10.0.0.5:8001").port) == ("10.0.0.5", 8001)
+    assert Replica("localhost:9/x").port == 9
+
+
+# ----------------------------------------------------------------------
+# dispatch + parity
+# ----------------------------------------------------------------------
+
+def test_dispatch_spreads_load_and_matches_offline(mv):
+    prompts = [[1, 2, 3], [5, 6, 7, 8], [20] * 7, [42, 43], [9],
+               [60, 61, 62]]
+    budgets = [4, 5, 3, 6, 4, 5]
+
+    async def main():
+        reps = [await Rep(mv).start() for _ in range(2)]
+        router = make_router(*reps)
+        await router.start()
+        outs = await asyncio.gather(*(
+            router.complete(p, b) for p, b in zip(prompts, budgets)))
+        await router.stop()
+        for r in reps:
+            await r.stop()
+        return router, outs
+
+    router, outs = run_async(main())
+    refs = offline_ref(mv, prompts, budgets)
+    for p, b, out, ref in zip(prompts, budgets, outs, refs):
+        assert out["tokens"] == ref[len(p):], f"diverged for {p}"
+        assert out["reason"] == "budget" and out["failovers"] == 0
+    m = router.metrics
+    assert m.counters["completed"] == len(prompts)
+    assert m.counters["shed"] == 0
+    # least-loaded + round-robin tiebreak: both replicas served traffic
+    assert len(m.dispatch_counts) == 2
+    assert all(n > 0 for n in m.dispatch_counts.values())
+
+
+# ----------------------------------------------------------------------
+# the tentpole property: failover idempotency (HTTP e2e)
+# ----------------------------------------------------------------------
+
+def test_failover_mid_stream_gapless_bit_identical(mv):
+    """Kill the serving replica mid-SSE-stream: the client sees ONE
+    stream — no gap, no duplicate, no error — and the full token
+    sequence is bit-identical to an uninterrupted offline greedy run.
+    The kill lands deterministically: replica A (throttled) is the only
+    replica at dispatch time; B is registered after the 4th token, then
+    A is killed."""
+    prompt, budget = [1, 2, 3], 24
+
+    async def main():
+        rep_a = await Rep(mv, step_delay=0.05).start()
+        rep_b = await Rep(mv).start()
+        router = make_router(rep_a)            # A is the only choice
+        await router.start()
+        app = RouterApp(router, port=0)
+        await app.start()
+
+        killed = asyncio.Event()
+
+        async def on_token(i):
+            if i == 4 and not killed.is_set():
+                killed.set()
+                router.add_replica(rep_b.addr)
+                await router.probe_all()       # B healthy before the kill
+                await rep_a.kill()
+
+        reader, writer = await http_post(
+            app.port, "/v1/completions",
+            {"prompt": prompt, "max_tokens": budget})
+        status = int((await reader.readline()).split(b" ")[1])
+        assert status == 200
+        while (await reader.readline()).strip():
+            pass
+        tokens, done = await read_sse(reader, on_token=on_token)
+        writer.close()
+
+        health = await http_get(app.port, "/healthz")
+        metrics_txt = await http_get(app.port, "/metrics")
+        await app.stop()
+        await router.stop()
+        await rep_b.stop()
+        return router, rep_b, tokens, done, health, metrics_txt
+
+    router, rep_b, tokens, done, (h_status, h_body), (m_status, m_body) \
+        = run_async(main())
+    (ref,) = offline_ref(mv, [prompt], [budget])
+    gen_ref = ref[len(prompt):]
+    # gapless + duplicate-free + bit-identical, through a mid-stream kill
+    assert tokens == gen_ref
+    assert done is not None and done.get("done")
+    assert done["reason"] == "budget"
+    assert done["failovers"] >= 1
+    m = router.metrics
+    assert m.counters["failovers"] >= 1
+    assert m.counters["completed"] == 1
+    assert m.counters["shed"] == 0
+    assert m.counters["replica_down"] >= 1
+    # the failover resumed on B with the streamed prefix as prompt
+    assert rep_b.eng.n_admitted >= 1
+    # surfaces: router healthz still OK on the survivor; prometheus text
+    assert h_status == 200 and json.loads(h_body)["ok"]
+    assert m_status == 200
+    assert 'router_requests_total{event="failovers"} 1' in m_body
+
+
+def test_failover_under_concurrent_load_zero_failed(mv):
+    """The acceptance property at test scale: N concurrent streams over
+    2 replicas, one replica killed mid-drive and restarted on the same
+    port — every request completes its FULL budget bit-identical to
+    offline greedy; nothing fails, nothing is shed, the restarted
+    replica rejoins."""
+    n_req = 8
+    prompts = [[i + 1, i + 2, i + 3] for i in range(n_req)]
+    budgets = [14] * n_req
+
+    async def main():
+        rep_a = await Rep(mv, n_slots=4, step_delay=0.03).start()
+        rep_b = await Rep(mv, n_slots=4, step_delay=0.03).start()
+        port_a = rep_a.app.port
+        # warm both replicas' prefill + fused-step traces so the drive
+        # streams tokens immediately (compile latency would otherwise
+        # let the kill land before any stream has a token)
+        for rep in (rep_a, rep_b):
+            await rep.sched.submit([1, 2, 3], 2).result()
+        router = make_router(rep_a, rep_b, retry_budget=4)
+        await router.start()
+
+        consumers = [asyncio.ensure_future(router.complete(p, b))
+                     for p, b in zip(prompts, budgets)]
+        # kill only once the victim is demonstrably mid-stream: >= 2
+        # fused steps of tokens fanned out across its live slots
+        deadline = asyncio.get_running_loop().time() + 10
+        while (rep_a.sched.metrics.counters["tokens_out"] < 10
+               and asyncio.get_running_loop().time() < deadline):
+            await asyncio.sleep(0.01)
+        await rep_a.kill()
+        await asyncio.sleep(0.2)
+        rep_a2 = await Rep(mv, n_slots=4, port=port_a).start()
+        outs = await asyncio.gather(*consumers)
+
+        # the restarted replica rejoins through the backoff prober
+        deadline = asyncio.get_running_loop().time() + 5
+        while (router.replicas[rep_a2.addr].state != "healthy"
+               and asyncio.get_running_loop().time() < deadline):
+            await asyncio.sleep(0.05)
+        rejoined = router.replicas[rep_a2.addr].state
+        post = await router.complete([7, 7, 7], 3)
+        await router.stop()
+        for r in (rep_b, rep_a2):
+            await r.stop()
+        return router, outs, rejoined, post
+
+    router, outs, rejoined, post = run_async(main())
+    refs = offline_ref(mv, prompts, budgets)
+    for p, b, out, ref in zip(prompts, budgets, outs, refs):
+        assert out["tokens"] == ref[len(p):], "failed-over stream diverged"
+        assert out["reason"] == "budget"
+    m = router.metrics
+    assert m.counters["completed"] == n_req + 1
+    assert m.counters["shed"] == 0                 # zero failed OR shed
+    assert m.counters["failovers"] >= 1            # the kill hit streams
+    assert rejoined == "healthy"
+    (post_ref,) = offline_ref(mv, [[7, 7, 7]], [3])
+    assert post["tokens"] == post_ref[3:]
+
+
+# ----------------------------------------------------------------------
+# explicit shed: retry budget, no replicas
+# ----------------------------------------------------------------------
+
+def test_kill_with_no_survivor_is_explicit_shed_not_hang(mv):
+    async def main():
+        rep = await Rep(mv, step_delay=0.05).start()
+        router = make_router(rep, retry_budget=2)
+        await router.start()
+        tokens, err = [], None
+        try:
+            async for ev in router.stream([1, 2, 3], 30):
+                if "token" in ev:
+                    tokens.append(ev["token"])
+                    if len(tokens) == 2:
+                        await rep.kill()
+        except ShedError as e:
+            err = e
+        await router.stop()
+        return router, tokens, err
+
+    router, tokens, err = run_async(main(), timeout=60)
+    assert err is not None, "mid-stream kill with no survivor must shed"
+    assert err.cause in ("replica_failure", "retries_exhausted",
+                         "no_replica")
+    m = router.metrics
+    assert m.counters["shed"] == 1
+    assert m.counters["completed"] == 0
+
+
+def test_no_healthy_replica_sheds_immediately(mv):
+    async def main():
+        # a port with nothing listening: the probe can never succeed
+        router = make_router("127.0.0.1:1")
+        await router.start()
+        err = None
+        try:
+            await router.complete([1, 2], 4)
+        except ShedError as e:
+            err = e
+        app = RouterApp(router, port=0)
+        await app.start()
+        h_status, _ = await http_get(app.port, "/healthz")
+        r, w = await http_post(app.port, "/v1/completions",
+                               {"prompt": [1], "max_tokens": 2})
+        status = int((await r.readline()).split(b" ")[1])
+        body = (await r.read()).split(b"\r\n\r\n")[-1]
+        w.close()
+        await app.stop()
+        await router.stop()
+        return err, h_status, status, json.loads(body)
+
+    err, h_status, status, body = run_async(main(), timeout=60)
+    assert err is not None and err.cause == "no_replica"
+    assert h_status == 503
+    assert status == 503 and body["cause"] == "no_replica"
+
+
+# ----------------------------------------------------------------------
+# draining restart
+# ----------------------------------------------------------------------
+
+def test_drain_hands_over_without_stream_loss(mv):
+    """Drain the replica serving a live stream: the stream runs to
+    completion (drain never cancels live work), new traffic goes to the
+    survivor only, and the drained replica's healthz flips 503 with
+    `drained: true` once quiesced — the kill-safe restart window."""
+
+    async def main():
+        rep_a = await Rep(mv, step_delay=0.03).start()
+        rep_b = await Rep(mv).start()
+        router = make_router(rep_a)            # stream lands on A
+        await router.start()
+
+        tokens = []
+        agen = router.stream([1, 2, 3], 16)
+        async for ev in agen:
+            tokens.append(ev["token"])
+            break                              # live on A now
+        router.add_replica(rep_b.addr)
+        await router.probe_all()
+        drain_resp = await router.drain(rep_a.addr)
+
+        # new requests must go to B (A is gated out)
+        before = dict(router.metrics.dispatch_counts)
+        outs = await asyncio.gather(*(router.complete([9, 8], 3)
+                                      for _ in range(3)))
+        after = dict(router.metrics.dispatch_counts)
+
+        # the live stream on A still finishes, gapless
+        done = None
+        async for ev in agen:
+            if "token" in ev:
+                tokens.append(ev["token"])
+            else:
+                done = ev
+        # A quiesces: healthz 503, draining, drained
+        deadline = asyncio.get_running_loop().time() + 10
+        while (not rep_a.sched.drained
+               and asyncio.get_running_loop().time() < deadline):
+            await asyncio.sleep(0.02)
+        h_status, h_body = await http_get(rep_a.app.port, "/healthz")
+        await router.stop()
+        await rep_a.stop()
+        await rep_b.stop()
+        return (router, tokens, done, drain_resp, before, after, outs,
+                h_status, json.loads(h_body))
+
+    (router, tokens, done, drain_resp, before, after, outs, h_status,
+     h_body) = run_async(main())
+    assert drain_resp["status"] == 200 and drain_resp["draining"]
+    (ref,) = offline_ref(mv, [[1, 2, 3]], [16])
+    assert tokens == ref[3:]                   # drain lost nothing
+    assert done is not None and done["reason"] == "budget"
+    a_name = next(n for n in router.replicas if before.get(n))
+    assert after.get(a_name, 0) == before.get(a_name, 0), \
+        "drained replica received new traffic"
+    for out in outs:
+        assert out["reason"] == "budget" and len(out["tokens"]) == 3
+    assert h_status == 503
+    assert h_body["draining"] and h_body["drained"]
+    assert not h_body["ok"]
+
+
+# ----------------------------------------------------------------------
+# failure detector: down -> backoff -> rejoin
+# ----------------------------------------------------------------------
+
+def test_down_replica_backs_off_and_rejoins(mv):
+    async def main():
+        rep = await Rep(mv).start()
+        port = rep.app.port
+        router = make_router(rep)
+        await router.start()
+        name = rep.addr
+        await rep.kill()
+        # probes trip the detector within fail_threshold * interval
+        deadline = asyncio.get_running_loop().time() + 5
+        while (router.replicas[name].state != "down"
+               and asyncio.get_running_loop().time() < deadline):
+            await asyncio.sleep(0.02)
+        state_after_kill = router.replicas[name].state
+        gate = router.replicas[name].next_probe_at - time.perf_counter()
+        down_events = router.metrics.counters["replica_down"]
+
+        rep2 = await Rep(mv, port=port).start()
+        deadline = asyncio.get_running_loop().time() + 5
+        while (router.replicas[name].state != "healthy"
+               and asyncio.get_running_loop().time() < deadline):
+            await asyncio.sleep(0.02)
+        state_after_restart = router.replicas[name].state
+        out = await router.complete([4, 5, 6], 4)
+        await router.stop()
+        await rep2.stop()
+        return (router, state_after_kill, gate, down_events,
+                state_after_restart, out)
+
+    (router, state_after_kill, gate, down_events, state_after_restart,
+     out) = run_async(main(), timeout=60)
+    assert state_after_kill == "down"
+    assert gate > -1.0                 # a backoff gate was scheduled
+    assert down_events >= 1
+    assert state_after_restart == "healthy"
+    assert router.metrics.counters["replica_up"] >= 2  # start + rejoin
+    assert out["reason"] == "budget" and len(out["tokens"]) == 4
+
+
+def test_router_metrics_render_smoke():
+    m = RouterMetrics()
+    m.inc("submitted")
+    m.dispatched("127.0.0.1:1")
+    m.shed("no_replica")
+    m.ttft.observe(0.01)
+    txt = m.render_prometheus()
+    assert 'router_requests_total{event="dispatched"} 1' in txt
+    assert 'router_shed_total{cause="no_replica"} 1' in txt
+    assert 'router_dispatch_total{replica="127.0.0.1:1"} 1' in txt
+    assert "router_ttft_seconds_count 1" in txt
+    s = m.summary()
+    assert s["dispatch_by_replica"] == {"127.0.0.1:1": 1}
+    assert s["shed_by_cause"] == {"no_replica": 1}

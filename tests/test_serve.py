@@ -12,7 +12,8 @@ import pytest
 from distributed_pytorch_tpu.config import LLMConfig
 from distributed_pytorch_tpu.engine import DecodeEngine
 from distributed_pytorch_tpu.models.gpt import LLM
-from distributed_pytorch_tpu.serve.scheduler import Scheduler, ShedError
+from distributed_pytorch_tpu.serve.scheduler import (EngineError,
+                                                     Scheduler, ShedError)
 
 
 def tiny_cfg(**kw):
@@ -238,6 +239,132 @@ def test_stop_sheds_queued_and_cancels_live(mv):
 
     eng = run_async(main())
     assert eng.n_live == 0
+
+
+# ----------------------------------------------------------------------
+# engine failure: every pending stream errors (never hangs), health flips
+# ----------------------------------------------------------------------
+
+def test_step_loop_crash_fails_all_pending_and_flips_health(mv):
+    """Regression: an exception escaping the background step loop must
+    fail EVERY pending handle with an explicit EngineError — the live
+    stream AND the queued one — flip `healthy` False (healthz 503), and
+    shed later submits immediately. Before the fix, handles could wait
+    forever on a loop that no longer existed."""
+
+    async def main():
+        eng = make_engine(mv, n_slots=1)
+        calls = []
+        orig_step = eng.step
+
+        def dying_step():
+            calls.append(1)
+            if len(calls) >= 2:
+                raise RuntimeError("device lost")
+            return orig_step()
+
+        eng.step = dying_step
+        sched = Scheduler(eng, max_queue=8)
+        await sched.start()
+        a = sched.submit([1, 2, 3], 30)       # takes the only slot
+        b = sched.submit([4, 5], 10)          # parked in the queue
+        errors = []
+        for h in (a, b):
+            try:
+                await h.result()
+            except EngineError as e:
+                errors.append(e)
+        healthy = sched.healthy
+        try:
+            sched.submit([6], 2)
+            post_shed = None
+        except ShedError as e:
+            post_shed = e
+        await sched.stop()
+        return sched, errors, healthy, post_shed
+
+    sched, errors, healthy, post_shed = run_async(main(), timeout=60)
+    assert len(errors) == 2, "a pending stream hung or finished silently"
+    assert all("device lost" in str(e) for e in errors)
+    assert healthy is False
+    assert sched.failed is not None
+    assert post_shed is not None and post_shed.cause == "engine_error"
+
+
+def test_admission_crash_fails_wave_popped_requests(mv):
+    """Regression for the subtle half of the bug: an admission wave pops
+    requests off the queue into a loop-local list BEFORE admitting them.
+    If `engine.admit` then raises, those requests are in neither `_live`
+    nor `_queue` — the old crash guard missed them and their streams
+    hung forever. The pending-handle registry must fail them too."""
+
+    async def main():
+        eng = make_engine(mv, n_slots=2)
+        calls = []
+        orig_admit = eng.admit
+
+        def dying_admit(prompt, max_new):
+            calls.append(1)
+            if len(calls) >= 2:
+                raise RuntimeError("admit exploded")
+            return orig_admit(prompt, max_new)
+
+        eng.admit = dying_admit
+        sched = Scheduler(eng, max_queue=8)
+        # queue BOTH before the loop starts: one wave pops both, the
+        # second admit raises with request #2 in the wave-local list
+        a = sched.submit([1, 2, 3], 4)
+        b = sched.submit([4, 5], 4)
+        await sched.start()
+        errors = []
+        for h in (a, b):
+            try:
+                await h.result()
+            except EngineError as e:
+                errors.append(e)
+        await sched.stop()
+        return errors
+
+    errors = run_async(main(), timeout=60)
+    assert len(errors) == 2, \
+        "a wave-popped request's stream hung on an admission crash"
+
+
+# ----------------------------------------------------------------------
+# draining: admission stops, queued + live work still completes
+# ----------------------------------------------------------------------
+
+def test_drain_sheds_new_serves_queued_and_live(mv):
+    async def main():
+        eng = make_engine(mv, n_slots=1)
+        sched = Scheduler(eng, max_queue=8)
+        await sched.start()
+        a = sched.submit([1, 2, 3], 8)        # live on the only slot
+        b = sched.submit([4, 5], 4)           # queued
+        await a.__anext__()
+        assert not sched.draining
+        sched.drain()
+        try:
+            sched.submit([6], 2)
+            shed = None
+        except ShedError as e:
+            shed = e
+        ra = await a.result()
+        rb = await b.result()
+        drained = sched.drained
+        healthy = sched.healthy               # loop alive, just gated
+        await sched.stop()
+        return sched, shed, ra, rb, drained, healthy, a, b
+
+    sched, shed, ra, rb, drained, healthy, a, b = run_async(main())
+    assert shed is not None and shed.cause == "draining"
+    assert sched.metrics.shed_counts.get("draining") == 1
+    # drain never drops accepted work: the live stream AND the queued
+    # one both deliver their full budgets
+    assert ra.reason == "budget" and len(a.tokens) == 8
+    assert rb.reason == "budget" and len(b.tokens) == 4
+    assert drained is True
+    assert healthy is True
 
 
 # ----------------------------------------------------------------------

@@ -280,3 +280,104 @@ def test_http_bad_requests(mv):
     status, body = out["json"]
     assert status == 200
     assert body["reason"] == "budget" and len(body["tokens"]) == 3
+
+
+def test_http_stalled_client_gets_408_and_frees_connection(mv):
+    """A slowloris client that never finishes its request head (or body)
+    must not hold a connection slot indefinitely: the per-connection
+    read timeout answers 408 and closes."""
+    _, model, variables = mv
+
+    async def main():
+        eng = DecodeEngine(model, variables, n_slots=1, temperature=0.0,
+                           min_bucket=8)
+        sched = Scheduler(eng, max_queue=4)
+        app = ServeApp(sched, port=0, request_timeout_s=0.2)
+        await sched.start()
+        await app.start()
+
+        # stalled HEAD: open, write half a request line, go silent
+        r1, w1 = await asyncio.open_connection("127.0.0.1", app.port)
+        w1.write(b"GET /healthz HT")
+        await w1.drain()
+        head1 = await asyncio.wait_for(r1.read(), 10)
+        w1.close()
+
+        # stalled BODY: full head promising bytes that never come
+        r2, w2 = await asyncio.open_connection("127.0.0.1", app.port)
+        w2.write(b"POST /v1/completions HTTP/1.1\r\nHost: t\r\n"
+                 b"Content-Length: 64\r\n\r\n{\"pro")
+        await w2.drain()
+        head2 = await asyncio.wait_for(r2.read(), 10)
+        w2.close()
+
+        # the server still serves a well-behaved client afterwards
+        status, _ = await http_get(app.port, "/healthz")
+        await app.stop()
+        await sched.stop()
+        return head1, head2, status
+
+    head1, head2, status = run_async(main(), timeout=60)
+    assert head1.startswith(b"HTTP/1.1 408")
+    assert head2.startswith(b"HTTP/1.1 408")
+    assert status == 200
+
+
+def test_healthz_is_readiness_503_on_drain_and_engine_death(mv):
+    """healthz is a readiness probe: 200 only while admitting. Draining
+    flips it 503 (with drained-state detail once quiesced); a dead step
+    loop flips it 503 with the failure. The router tier health-gates on
+    exactly this."""
+    _, model, variables = mv
+
+    async def main():
+        eng = DecodeEngine(model, variables, n_slots=1, temperature=0.0,
+                           min_bucket=8)
+        sched = Scheduler(eng, max_queue=4)
+        app = ServeApp(sched, port=0)
+        await sched.start()
+        await app.start()
+        s_ok, b_ok = await http_get(app.port, "/healthz")
+
+        # drain via the admin endpoint -> 503 draining, then drained
+        r, w = await http_post(app.port, "/admin/drain", {})
+        drain_status = int((await r.readline()).split(b" ")[1])
+        w.close()
+        s_drain, b_drain = await http_get(app.port, "/healthz")
+        deadline = asyncio.get_running_loop().time() + 10
+        while (not sched.drained
+               and asyncio.get_running_loop().time() < deadline):
+            await asyncio.sleep(0.02)
+        s_drained, b_drained = await http_get(app.port, "/healthz")
+
+        # engine death on a fresh stack -> 503 failed
+        eng2 = DecodeEngine(model, variables, n_slots=1, temperature=0.0,
+                            min_bucket=8)
+        eng2.step = lambda: (_ for _ in ()).throw(RuntimeError("boom"))
+        sched2 = Scheduler(eng2, max_queue=4)
+        app2 = ServeApp(sched2, port=0)
+        await sched2.start()
+        await app2.start()
+        h = sched2.submit([1, 2, 3], 4)
+        try:
+            await h.result()
+        except Exception:
+            pass
+        s_dead, b_dead = await http_get(app2.port, "/healthz")
+
+        await app.stop()
+        await sched.stop()
+        await app2.stop()
+        await sched2.stop()
+        return (s_ok, json.loads(b_ok), drain_status, s_drain,
+                json.loads(b_drain), s_drained, json.loads(b_drained),
+                s_dead, json.loads(b_dead))
+
+    (s_ok, b_ok, drain_status, s_drain, b_drain, s_drained, b_drained,
+     s_dead, b_dead) = run_async(main(), timeout=120)
+    assert s_ok == 200 and b_ok["ok"] and not b_ok["draining"]
+    assert drain_status == 200
+    assert s_drain == 503 and b_drain["draining"] and not b_drain["ok"]
+    assert s_drained == 503 and b_drained["drained"]
+    assert s_dead == 503 and not b_dead["ok"]
+    assert "boom" in b_dead["failed"]

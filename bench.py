@@ -170,11 +170,18 @@ def _decode_bench(platform: str) -> dict:
     while eng.free_slots:
         eng.admit(mk(), big)
     eng.step()                               # compiles the fused step
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        eng.step()
-    jax.device_get(eng.tok)
-    dt = time.perf_counter() - t0
+    # BENCH_PROFILE=1: wrap the steady window in a device-profiler
+    # capture (obs/profile.py) so a TPU-window leg ships an xplane next
+    # to its JSON line
+    from distributed_pytorch_tpu.obs import profile as obs_profile
+    with obs_profile.profile_trace(
+            run="bench_decode",
+            enabled=os.environ.get("BENCH_PROFILE", "") == "1") as prof:
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            eng.step()
+        jax.device_get(eng.tok)
+        dt = time.perf_counter() - t0
     steady = slots * iters / dt
 
     # MBU from the bytes-moved model at the window's mean cache length,
@@ -226,7 +233,8 @@ def _decode_bench(platform: str) -> dict:
             "cache_dtype": jnp.dtype(eng.cache_dtype).name,
             "quant_w": eng.weights_quantized,
             "n_chips": n_dev, "device": jax.devices()[0].device_kind,
-            "preset": preset}
+            "preset": preset,
+            **({"profile_dir": prof} if prof else {})}
 
 
 def _serve_bench(platform: str) -> dict:
@@ -255,6 +263,7 @@ def _serve_bench(platform: str) -> dict:
     from distributed_pytorch_tpu.config import LLMConfig, flagship_gpt124m
     from distributed_pytorch_tpu.engine import DecodeEngine
     from distributed_pytorch_tpu.models.gpt import LLM
+    from distributed_pytorch_tpu.obs import trace as obs_trace
     from distributed_pytorch_tpu.serve.scheduler import Scheduler, ShedError
 
     n_dev = len(jax.devices())
@@ -362,7 +371,11 @@ def _serve_bench(platform: str) -> dict:
                 if delay > 0:
                     await asyncio.sleep(delay)
                 try:
-                    h = sched.submit(prompt, budget)
+                    # trace every request: spans are emitted once at
+                    # retirement (request-scale, never token-scale), and
+                    # the span ring becomes the trace.jsonl artifact
+                    h = sched.submit(prompt, budget,
+                                     trace_id=obs_trace.new_trace_id())
                 except ShedError:
                     shed += 1
                     continue
@@ -437,6 +450,21 @@ def _serve_bench(platform: str) -> dict:
         ppr, base_ppr = (out["prefilled_per_request"],
                          out["prefilled_per_request_baseline"])
         out["prefill_reduction_x"] = round(base_ppr / max(ppr, 1e-9), 2)
+    # persist the observability artifacts (ISSUE 9): the engine's
+    # step-level flight timeline and the per-request trace spans go to
+    # runs/, referenced from the JSON line so the TPU-window analysis
+    # (PERF.md latency models) can replay the drive post-hoc
+    try:
+        art_dir = os.path.join("runs", f"bench_serve_{int(time.time())}")
+        arts = {"step_timeline": eng.flight.dump_jsonl(
+            os.path.join(art_dir, "timeline.jsonl"))}
+        rec = obs_trace.get_recorder()
+        if len(rec):
+            arts["trace"] = rec.dump_jsonl(
+                os.path.join(art_dir, "trace.jsonl"))
+        out["artifacts"] = arts
+    except Exception as e:  # noqa: BLE001 — artifacts never sink the leg
+        out["artifacts_error"] = repr(e)
     return out
 
 
@@ -624,13 +652,27 @@ def _serve_chunked_bench(platform: str) -> dict:
         return {"load_1x": leg(e, base_load, fused_s),
                 "load_2x": leg(e, 2 * base_load, fused_s)}
 
+    # artifact dir for the per-config step timelines (flight recorder):
+    # the ITL-p99-vs-step evidence the chunk-size pick reads post hoc
+    art_dir = os.path.join("runs", f"bench_serve_chunked_{int(time.time())}")
+    artifacts = {}
+
+    def dump_timeline(e, tag: str) -> None:
+        try:
+            artifacts[tag] = e.flight.dump_jsonl(
+                os.path.join(art_dir, f"timeline_{tag}.jsonl"))
+        except Exception:  # noqa: BLE001 — artifacts never sink the leg
+            pass
+
     wave = run_pair(wave_eng)
+    dump_timeline(wave_eng, "wave")
     by_chunk = {}
     for c in chunks:
         e = make_engine(c)
         fused_s = probe_fused(e)
         by_chunk[str(c)] = run_pair(e, fused_s)
         by_chunk[str(c)]["fused_step_ms"] = round(fused_s * 1e3, 2)
+        dump_timeline(e, f"chunk{c}")
     def worst_ratio(r: dict) -> float:
         return max(r[f"load_{t}"].get("itl_p99_over_fused") or 9e9
                    for t in ("1x", "2x"))
@@ -660,6 +702,7 @@ def _serve_chunked_bench(platform: str) -> dict:
             "vs_baseline": 0,
             "probe_step_ms": round(step_s * 1e3, 2),
             "best_chunk": int(best_c), "accept": accept,
+            "artifacts": artifacts,
             "wave_baseline": wave, "chunked": by_chunk,
             "chunk_sizes": chunks, "base_load_factor": base_load,
             "n_requests": n_req, "n_slots": slots, "cache_len": S,
@@ -734,7 +777,8 @@ def _serve_router_bench(platform: str) -> dict:
                 "failed", "parity_mismatches", "failovers", "retries",
                 "replica_down", "replica_up", "offered_rps",
                 "ttft_p50_ms", "ttft_p99_ms", "itl_p50_ms",
-                "itl_p99_ms", "shed_by_cause") if k in out}}
+                "itl_p99_ms", "shed_by_cause", "artifacts",
+                "log_dir") if k in out}}
 
 
 def run_bench(platform: str, only_recipe: str | None = None) -> dict:

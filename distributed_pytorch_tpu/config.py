@@ -288,6 +288,9 @@ class TrainConfig:
     resume: bool = False
     log_interval: int = 1
     profile: bool = False            # jax.profiler trace capture
+    profile_dir: str = ""            # capture output dir; "" = the
+                                     # obs/profile.py convention
+                                     # runs/<file_name>/profile
 
     def __post_init__(self):
         assert self.parallelism in PARALLELISM_RECIPES, \
@@ -395,7 +398,7 @@ def configs_from_args(args: argparse.Namespace,
     train_defaults = train_defaults or TrainConfig()
     model_fields = {f.name for f in dataclasses.fields(LLMConfig)}
     train_fields = {f.name for f in dataclasses.fields(TrainConfig)}
-    no_lower = {"non_linearity", "file_name", "data_dir"}
+    no_lower = {"non_linearity", "file_name", "data_dir", "profile_dir"}
 
     m_kw, t_kw = {}, {}
     for key, value in vars(args).items():

@@ -80,6 +80,7 @@ import numpy as np
 
 from distributed_pytorch_tpu.models.generate import sample_token
 from distributed_pytorch_tpu.models.gpt import init_paged_cache
+from distributed_pytorch_tpu.obs.flight import FlightRecorder
 from distributed_pytorch_tpu.ops.block_pool import (BlockPool, NoFreeBlocks,
                                                     chain_keys)
 from distributed_pytorch_tpu.parallel import context
@@ -200,7 +201,8 @@ class DecodeEngine:
                  block_size: Optional[int] = None,
                  n_blocks: Optional[int] = None,
                  prefix_cache: bool = True,
-                 prefill_chunk: int = 0):
+                 prefill_chunk: int = 0,
+                 flight_capacity: int = 4096):
         cfg = model.config
         self.model = model
         self.cfg = cfg
@@ -336,6 +338,10 @@ class DecodeEngine:
         self.prompt_tokens = 0        # prompt tokens across admissions
         self.prefix_hit_tokens = 0    # of those, served from cached blocks
         self.prefilled_tokens = 0     # suffix tokens actually prefilled
+        # step-level flight recorder (obs/flight.py): one record per
+        # fused step in a bounded ring — the /debug/timeline payload and
+        # the runs/*.jsonl post-hoc artifact
+        self.flight = FlightRecorder(capacity=flight_capacity)
 
     # ------------------------------------------------------------------
     # jitted device programs
@@ -835,10 +841,12 @@ class DecodeEngine:
         them)."""
         if not self._slots:
             return StepResult({}, {})
+        t_step0 = time.perf_counter()
         preempted = self._ensure_blocks()
         chunk = self._next_chunk(preempted) if self.prefill_chunk else None
         if not self._slots or (chunk is None and not self._live_slots()):
             return StepResult({}, preempted)
+        n_live_in = len(self._live_slots())    # decoding slots this step
         self._sync_tables()
         chunk_done = False
         if chunk is not None:
@@ -910,6 +918,14 @@ class DecodeEngine:
         # zeroed, so any residual write lands in the null block)
         if len(retired) > len(preempted):
             self._rebuild_live()
+        self.flight.record(
+            step=self._t,
+            step_ms=round((time.perf_counter() - t_step0) * 1e3, 3),
+            n_live=n_live_in, prefill_tokens=prefill_tokens,
+            emitted=len(emitted),
+            retired=len(retired) - len(preempted),
+            blocks_in_use=self.block_pool.n_referenced,
+            preemptions=len(preempted))
         return StepResult(emitted=emitted, retired=retired,
                           prefill_tokens=prefill_tokens)
 

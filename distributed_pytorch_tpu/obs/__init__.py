@@ -1,0 +1,27 @@
+"""Observability subsystem: structured request tracing, the step-level
+flight recorder, and shared on-demand device profiling.
+
+Three tools, one package (ISSUE 9):
+
+* `obs.trace` — a near-zero-overhead span/event recorder. Every serving
+  request carries a trace id (minted at the router, or at the replica
+  server when unfronted, propagated via the `X-Trace-Id` header) and its
+  lifecycle — router dispatch, queue wait, chunked prefill, decode,
+  failover re-dispatch, retire — lands as spans in a bounded ring,
+  exportable as Chrome-trace/Perfetto JSON or JSONL.
+* `obs.flight` — the engine's step-level flight recorder: one compact
+  record per fused step ({step_ms, n_live, prefill_tokens, emitted,
+  blocks_in_use, preemptions}) in a bounded ring, served at
+  `GET /debug/timeline` and dumpable to `runs/*.jsonl` — the post-hoc
+  tool for ITL-p99 spikes the aggregate histograms only hint at.
+* `obs.profile` — the one shared `jax.profiler` wrapper (train loop,
+  serve `POST /admin/profile`, bench legs) with a `runs/<run>/profile`
+  output convention, replacing the hardcoded train-loop trace dir.
+"""
+
+from distributed_pytorch_tpu.obs.flight import FlightRecorder
+from distributed_pytorch_tpu.obs.trace import (TraceRecorder, get_recorder,
+                                               new_trace_id, set_recorder)
+
+__all__ = ["FlightRecorder", "TraceRecorder", "get_recorder",
+           "new_trace_id", "set_recorder"]

@@ -71,6 +71,14 @@ def build_args(argv=None):
                    dest="request_timeout_s", type=float, default=30.0,
                    help="per-connection read timeout while parsing a "
                         "request (stalled clients get 408)")
+    p.add_argument("--no-trace", dest="trace", action="store_false",
+                   help="disable the request-trace recorder (obs/trace.py"
+                        "; spans cost ~µs per REQUEST, so default on — "
+                        "this is the A/B-overhead escape hatch)")
+    p.add_argument("--profile-dir", "--profile_dir", dest="profile_dir",
+                   type=str, default="",
+                   help="output dir for POST /admin/profile captures "
+                        "(default runs/serve/profile)")
     p.add_argument("--prefill-chunk", "--prefill_chunk",
                    dest="prefill_chunk", type=int, default=0,
                    help="fuse Sarathi-style chunked prefill into the "
@@ -99,8 +107,12 @@ def _demo_model():
 
 async def _amain(args) -> None:
     from distributed_pytorch_tpu.engine import DecodeEngine
+    from distributed_pytorch_tpu.obs import trace as obs_trace
     from distributed_pytorch_tpu.serve.scheduler import Scheduler
     from distributed_pytorch_tpu.serve.server import ServeApp
+
+    if not args.trace:
+        obs_trace.get_recorder().enabled = False
 
     if args.demo:
         model, variables, mesh, recipe = _demo_model()
@@ -126,9 +138,15 @@ async def _amain(args) -> None:
                        prefill_chunk=args.prefill_chunk)
     sched = Scheduler(eng, max_queue=args.max_queue,
                       default_deadline_s=args.deadline_s)
+    # provenance labels for /metrics scrapes and bench JSON (the engine
+    # half is set by the Scheduler; add what only the CLI knows)
+    sched.metrics.set_build_info(
+        preset="demo" if args.demo else (args.ckpt or ""),
+        trace=args.trace)
     app = ServeApp(sched, host=args.host, port=args.port, encoder=encoder,
                    default_max_tokens=args.max_tokens_default,
-                   request_timeout_s=args.request_timeout_s)
+                   request_timeout_s=args.request_timeout_s,
+                   profile_dir=args.profile_dir or None)
     await sched.start()
     await app.start()
     print(f"serving on http://{args.host}:{app.port} "

@@ -37,6 +37,54 @@ LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
 PREFILL_TOKEN_BUCKETS = (0, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
 
 
+def engine_build_info(engine) -> dict:
+    """The engine's serving-relevant config, for the build-info gauge:
+    a scrape (or a bench JSON) carries its own provenance, so an A/B
+    line can never be mistaken for a different knob setting. Reads via
+    getattr so any engine-shaped object works."""
+    info: dict = {}
+    cfg = getattr(engine, "cfg", None)
+    if cfg is not None:
+        info["model"] = (f"L{getattr(cfg, 'n_layer', '?')}"
+                         f"xD{getattr(cfg, 'n_embd', '?')}"
+                         f"-{getattr(cfg, 'attn', '?')}")
+    for label, attr in (("n_slots", "n_slots"), ("max_len", "max_len"),
+                        ("kv_block", "block_size"),
+                        ("kv_blocks", "n_blocks"),
+                        ("prefill_chunk", "prefill_chunk"),
+                        ("prefix_cache", "prefix_cache"),
+                        ("quant_weights", "weights_quantized")):
+        v = getattr(engine, attr, None)
+        if v is not None:
+            info[label] = v
+    cd = getattr(engine, "cache_dtype", None)
+    if cd is not None:
+        try:
+            import jax.numpy as jnp
+            info["cache_dtype"] = jnp.dtype(cd).name
+        except Exception:  # noqa: BLE001 — provenance is best-effort
+            info["cache_dtype"] = str(cd)
+    try:
+        import jax
+        info["jax"] = jax.__version__
+    except Exception:  # noqa: BLE001 — a jax-less process still renders
+        pass
+    return info
+
+
+def _render_info(name: str, help_: str, info: dict) -> list[str]:
+    """Prometheus info-gauge idiom: constant 1 with the facts as labels."""
+    if not info:
+        return []
+
+    def esc(v) -> str:
+        return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+    labels = ",".join(f'{k}="{esc(v)}"' for k, v in sorted(info.items()))
+    return [f"# HELP {name} {help_}", f"# TYPE {name} gauge",
+            f"{name}{{{labels}}} 1"]
+
+
 class Histogram:
     """Prometheus-style cumulative histogram + exact quantiles."""
 
@@ -139,6 +187,7 @@ class ServeMetrics:
         self.counters = dict.fromkeys(self.COUNTERS, 0)
         self.shed_counts: dict[str, int] = {}     # cause -> n
         self.retire_counts: dict[str, int] = {}   # reason -> n
+        self.build_info: dict[str, str] = {}      # provenance labels
         self._occ_sum = 0.0
         self._occ_n = 0
 
@@ -173,10 +222,19 @@ class ServeMetrics:
         """Register a live-read gauge (queue depth, slot occupancy)."""
         self._gauges[name] = (fn, help_)
 
+    def set_build_info(self, **info) -> None:
+        """Merge provenance labels into the build-info gauge (model
+        preset, prefill_chunk, kv block size, cache dtype, jax version —
+        whatever identifies THIS serving config in a scrape)."""
+        self.build_info.update({k: str(v) for k, v in info.items()})
+
     # ------------------------------------------------------------------
     def render_prometheus(self) -> str:
         """The `/metrics` payload (Prometheus text exposition 0.0.4)."""
-        lines: list[str] = []
+        lines: list[str] = _render_info(
+            "serve_build_info",
+            "serving config provenance (labels; value always 1)",
+            self.build_info)
         for h in (self.ttft, self.itl, self.e2e, self.queue_wait,
                   self.prefill_tokens_per_step):
             lines += h.render()
@@ -225,6 +283,8 @@ class ServeMetrics:
                                                         scale=1.0),
                "mean_occupancy": round(self.mean_occupancy, 4)}
         out.update(self.counters)
+        if self.build_info:
+            out["build_info"] = dict(self.build_info)
         if self.shed_counts:
             out["shed_by_cause"] = dict(self.shed_counts)
         if self.retire_counts:
@@ -268,6 +328,7 @@ class RouterMetrics:
         self.counters = dict.fromkeys(self.COUNTERS, 0)
         self.shed_counts: dict[str, int] = {}        # cause -> n
         self.dispatch_counts: dict[str, int] = {}    # replica -> n
+        self.build_info: dict[str, str] = {}         # provenance labels
 
     def inc(self, name: str, n: int = 1) -> None:
         self.counters[name] += n
@@ -285,8 +346,15 @@ class RouterMetrics:
                        help_: str = "") -> None:
         self._gauges[name] = (fn, help_)
 
+    def set_build_info(self, **info) -> None:
+        """Merge provenance labels into the router build-info gauge."""
+        self.build_info.update({k: str(v) for k, v in info.items()})
+
     def render_prometheus(self) -> str:
-        lines: list[str] = []
+        lines: list[str] = _render_info(
+            "router_build_info",
+            "router config provenance (labels; value always 1)",
+            self.build_info)
         for h in (self.ttft, self.itl, self.e2e):
             lines += h.render()
         lines += ["# HELP router_requests_total router request lifecycle",
@@ -329,6 +397,8 @@ class RouterMetrics:
         out = {"ttft": self.ttft.summary(), "itl": self.itl.summary(),
                "e2e": self.e2e.summary()}
         out.update(self.counters)
+        if self.build_info:
+            out["build_info"] = dict(self.build_info)
         if self.shed_counts:
             out["shed_by_cause"] = dict(self.shed_counts)
         if self.dispatch_counts:

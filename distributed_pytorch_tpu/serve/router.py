@@ -55,6 +55,7 @@ import json
 import time
 from typing import AsyncIterator, Optional
 
+from distributed_pytorch_tpu.obs import trace as obs_trace
 from distributed_pytorch_tpu.serve.metrics import RouterMetrics
 from distributed_pytorch_tpu.serve.scheduler import ShedError
 from distributed_pytorch_tpu.serve.server import (_json_response,
@@ -165,8 +166,15 @@ class Router:
             "router_inflight_requests",
             lambda: sum(r.inflight for r in self.replicas.values()),
             "requests dispatched and not yet finished")
+        self.metrics.set_build_info(replicas=len(self.replicas),
+                                    retry_budget=retry_budget,
+                                    probe_interval_s=probe_interval_s)
         self._probe_task: Optional[asyncio.Task] = None
         self._rr = 0                   # round-robin tiebreak cursor
+
+    @property
+    def tracer(self) -> obs_trace.TraceRecorder:
+        return obs_trace.get_recorder()
 
     # ------------------------------------------------------------------
     # lifecycle / membership
@@ -292,30 +300,53 @@ class Router:
         return ties[self._rr % len(ties)]
 
     async def stream(self, prompt: list, max_tokens: int, *,
-                     deadline_s: Optional[float] = None) \
+                     deadline_s: Optional[float] = None,
+                     trace_id: Optional[str] = None) \
             -> AsyncIterator[dict]:
         """The router's request path: yields `{"token": id}` events and
         one final `{"done": ..., "reason": ..., "n_tokens": ...,
-        "failovers": ...}`. Raises `ShedError` (with a cause) when the
-        request cannot be served — after the retry budget, or with no
-        healthy replica. On a mid-stream replica death the stream
-        CONTINUES from a healthy replica at the exact token offset; the
-        consumer sees nothing but one longer inter-token gap."""
+        "failovers": ..., "trace_id": ..., "spans": [...]}`. Raises
+        `ShedError` (with a cause) when the request cannot be served —
+        after the retry budget, or with no healthy replica. On a
+        mid-stream replica death the stream CONTINUES from a healthy
+        replica at the exact token offset; the consumer sees nothing but
+        one longer inter-token gap.
+
+        Tracing: the trace id is minted HERE (or taken from the caller's
+        `X-Trace-Id`) and propagated to every replica dispatch, so a
+        failed-over stream is ONE trace — each attempt a
+        `router.dispatch` span, the dead attempt marked with its error,
+        and the replica-side spans (queue/prefill/decode, carried home on
+        the done event) re-based onto this process's clock at the
+        dispatch timestamp. `GET /debug/trace/<id>` on the RouterApp
+        replays the stitched timeline."""
         t_submit = time.perf_counter()
+        tid = trace_id or obs_trace.new_trace_id()
+        tr = self.tracer
         self.metrics.inc("submitted")
         got: list[int] = []
         attempts = 0
         tried: set[str] = set()
         last_tok_at: Optional[float] = None
         last_cause, last_msg = "no_replica", "no healthy replica"
+
+        def _end_request(outcome: str, now: Optional[float] = None):
+            tr.add("router.request", tid,
+                   t0=t_submit,
+                   dur=(now or time.perf_counter()) - t_submit,
+                   cat="router", outcome=outcome, tokens=len(got),
+                   failovers=attempts)
+
         while True:
             try:
                 rep = self.pick(exclude=tried)
             except NoReplica:
                 self.metrics.shed(last_cause)
+                _end_request(f"shed:{last_cause}")
                 raise ShedError(last_cause, last_msg) from None
             self.metrics.dispatched(rep.name)
             rep.inflight += 1
+            t_disp = time.perf_counter()
             # failover offset: everything already streamed becomes
             # prompt (greedy decode is deterministic, so the resumed
             # stream is bit-identical to an uninterrupted one) and the
@@ -327,7 +358,8 @@ class Router:
                 # failover already streams, shedding it would be
                 # user-visible loss (same exemption the scheduler gives
                 # preemption resumes)
-                deadline_s=deadline_s if not got else None)
+                deadline_s=deadline_s if not got else None,
+                trace_id=tid)
             try:
                 async for ev in inner:
                     if "token" in ev:
@@ -342,26 +374,49 @@ class Router:
                         tried.clear()     # progress: all replicas back in
                         yield ev
                     elif "done" in ev:
+                        now = time.perf_counter()
+                        # stitch the replica's spans onto this clock at
+                        # the dispatch timestamp, then close the attempt
+                        # and the request span BEFORE the done event so
+                        # its summary is complete
+                        if ev.get("spans"):
+                            tr.ingest(tid, ev["spans"], base=t_disp,
+                                      replica=rep.name)
+                        tr.add("router.dispatch", tid, t0=t_disp,
+                               dur=now - t_disp, cat="router",
+                               replica=rep.name, attempt=attempts,
+                               outcome="done")
                         self.metrics.inc("completed")
-                        self.metrics.e2e.observe(
-                            time.perf_counter() - t_submit)
-                        yield {"done": True,
-                               "reason": ev.get("reason"),
-                               "n_tokens": len(got),
-                               "failovers": attempts}
+                        self.metrics.e2e.observe(now - t_submit)
+                        _end_request("done", now)
+                        done_ev = {"done": True,
+                                   "reason": ev.get("reason"),
+                                   "n_tokens": len(got),
+                                   "failovers": attempts,
+                                   "trace_id": tid}
+                        if tr.enabled:
+                            done_ev["spans"] = tr.summary(tid,
+                                                          base=t_submit)
+                        yield done_ev
                         return
             except ReplicaShed as e:
+                tr.add("router.dispatch", tid, t0=t_disp,
+                       dur=time.perf_counter() - t_disp, cat="router",
+                       replica=rep.name, attempt=attempts,
+                       outcome=f"shed:{e.cause}")
                 if e.cause == "deadline":
                     # the request's own SLO expired in a replica queue —
                     # that is the client's explicit backpressure signal,
                     # not a replica fault; propagate, don't retry
                     self.metrics.shed("deadline")
+                    _end_request("shed:deadline")
                     raise ShedError("deadline", str(e)) from None
                 last_cause, last_msg = e.cause, str(e)
                 attempts += 1
                 tried.add(rep.name)
                 if attempts > self.retry_budget:
                     self.metrics.shed("retries_exhausted")
+                    _end_request("shed:retries_exhausted")
                     raise ShedError(
                         "retries_exhausted",
                         f"{attempts} dispatch attempts failed "
@@ -374,12 +429,18 @@ class Router:
                 # in-band detection: the replica died under a real
                 # request — down NOW, probe brings it back later
                 self._note_failure(rep, f"in-band: {e!r}", in_band=True)
+                tr.add("router.dispatch", tid, t0=t_disp,
+                       dur=time.perf_counter() - t_disp, cat="router",
+                       replica=rep.name, attempt=attempts,
+                       outcome="replica_failure", tokens=len(got),
+                       error=repr(e)[:200])
                 last_cause = "replica_failure"
                 last_msg = f"replica {rep.name} failed: {e!r}"
                 attempts += 1
                 tried.add(rep.name)
                 if attempts > self.retry_budget:
                     self.metrics.shed("retries_exhausted")
+                    _end_request("shed:retries_exhausted")
                     raise ShedError(
                         "retries_exhausted",
                         f"{attempts} dispatch attempts failed (last: "
@@ -387,15 +448,23 @@ class Router:
                 if got:
                     self.metrics.inc("failovers")
                     self.metrics.inc("replayed_tokens", len(got))
+                    tr.event("router.failover", tid, cat="router",
+                             from_replica=rep.name, tokens=len(got))
                 else:
                     self.metrics.inc("retries")
                 if max_tokens - len(got) <= 0:
                     # died between the last budgeted token and its done
                     # event: the stream is already complete
+                    now = time.perf_counter()
                     self.metrics.inc("completed")
-                    self.metrics.e2e.observe(time.perf_counter() - t_submit)
-                    yield {"done": True, "reason": "budget",
-                           "n_tokens": len(got), "failovers": attempts}
+                    self.metrics.e2e.observe(now - t_submit)
+                    _end_request("done", now)
+                    done_ev = {"done": True, "reason": "budget",
+                               "n_tokens": len(got),
+                               "failovers": attempts, "trace_id": tid}
+                    if tr.enabled:
+                        done_ev["spans"] = tr.summary(tid, base=t_submit)
+                    yield done_ev
                     return
                 continue
             finally:
@@ -409,18 +478,25 @@ class Router:
                     pass
 
     async def complete(self, prompt: list, max_tokens: int, *,
-                       deadline_s: Optional[float] = None) -> dict:
-        """Non-streaming collect: returns {tokens, reason, failovers}."""
+                       deadline_s: Optional[float] = None,
+                       trace_id: Optional[str] = None) -> dict:
+        """Non-streaming collect: returns {tokens, reason, failovers,
+        trace_id, spans}."""
         tokens: list[int] = []
         done: dict = {}
         async for ev in self.stream(prompt, max_tokens,
-                                    deadline_s=deadline_s):
+                                    deadline_s=deadline_s,
+                                    trace_id=trace_id):
             if "token" in ev:
                 tokens.append(ev["token"])
             else:
                 done = ev
-        return {"tokens": tokens, "reason": done.get("reason"),
-                "failovers": done.get("failovers", 0)}
+        out = {"tokens": tokens, "reason": done.get("reason"),
+               "failovers": done.get("failovers", 0)}
+        for k in ("trace_id", "spans"):
+            if k in done:
+                out[k] = done[k]
+        return out
 
     # ------------------------------------------------------------------
     # replica HTTP client (stdlib asyncio, mirrors the server's framing)
@@ -458,12 +534,15 @@ class Router:
 
     async def _stream_once(self, rep: Replica, prompt: list,
                            max_tokens: int,
-                           deadline_s: Optional[float]) \
+                           deadline_s: Optional[float],
+                           trace_id: Optional[str] = None) \
             -> AsyncIterator[dict]:
-        """One dispatch: POST the completion to `rep`, yield its SSE
-        events. Raises ReplicaShed on an explicit upstream refusal and
-        ReplicaConnError/transport errors on anything that smells like a
-        dead replica (EOF before the done event included)."""
+        """One dispatch: POST the completion to `rep` (propagating the
+        trace id via `X-Trace-Id`, so the replica's spans land on the
+        same end-to-end trace), yield its SSE events. Raises ReplicaShed
+        on an explicit upstream refusal and ReplicaConnError/transport
+        errors on anything that smells like a dead replica (EOF before
+        the done event included)."""
         body: dict = {"prompt": prompt, "max_tokens": max_tokens,
                       "stream": True}
         if deadline_s is not None:
@@ -471,8 +550,11 @@ class Router:
         reader, writer = await self._connect(rep, self.connect_timeout_s)
         try:
             payload = json.dumps(body).encode()
+            trace_hdr = (f"{obs_trace.TRACE_HEADER}: {trace_id}\r\n"
+                         if trace_id else "")
             writer.write(
                 (f"POST /v1/completions HTTP/1.1\r\nHost: {rep.name}\r\n"
+                 f"{trace_hdr}"
                  f"Content-Length: {len(payload)}\r\n\r\n").encode()
                 + payload)
             await writer.drain()
@@ -594,7 +676,13 @@ class RouterApp:
             if len(parts) < 2:
                 writer.write(_json_response(400, {"error": "bad request"}))
                 return
-            method, path = parts[0].upper(), parts[1].split("?")[0]
+            method, fullpath = parts[0].upper(), parts[1]
+            path, _, qs = fullpath.partition("?")
+            query = {}
+            if qs:
+                import urllib.parse
+                query = {k: v[0] for k, v in
+                         urllib.parse.parse_qs(qs).items()}
             headers = {}
             for line in header_lines:
                 if ":" in line:
@@ -613,6 +701,8 @@ class RouterApp:
                     200, body, "text/plain; version=0.0.4; charset=utf-8"))
             elif method == "GET" and path == "/admin/replicas":
                 writer.write(_json_response(200, self.router.snapshot()))
+            elif method == "GET" and path.startswith("/debug/trace/"):
+                writer.write(self._debug_trace(path, query))
             elif method == "POST" and path == "/v1/completions":
                 await self._completions(reader, writer, headers)
             elif method == "POST" and path in ("/admin/drain",
@@ -621,7 +711,8 @@ class RouterApp:
                 await self._admin(reader, writer, headers, path)
             elif path in ("/healthz", "/metrics", "/v1/completions",
                           "/admin/replicas", "/admin/drain",
-                          "/admin/add_replica", "/admin/remove_replica"):
+                          "/admin/add_replica", "/admin/remove_replica") \
+                    or path.startswith("/debug/trace/"):
                 writer.write(_json_response(405, {"error": "method not "
                                                            "allowed"}))
             else:
@@ -682,7 +773,27 @@ class RouterApp:
             writer.write(_json_response(200 if removed else 404,
                                         {"removed": removed}))
 
+    def _debug_trace(self, path: str, query: dict) -> bytes:
+        """`GET /debug/trace/<id>`: the stitched cross-process timeline —
+        the router's own dispatch/failover spans plus every replica's
+        ingested spans for that trace. `?fmt=chrome` returns
+        Perfetto-loadable Chrome-trace JSON."""
+        tid = path.rsplit("/", 1)[1]
+        tr = self.router.tracer
+        spans = tr.spans_for(tid)
+        if not spans:
+            return _json_response(404, {"error": f"no spans for trace "
+                                                 f"{tid!r}"})
+        if query.get("fmt") in ("chrome", "perfetto"):
+            return _json_response(200, tr.to_chrome(tid))
+        return _json_response(200, {"trace_id": tid,
+                                    "n_spans": len(spans),
+                                    "spans": tr.summary(tid)})
+
     async def _completions(self, reader, writer, headers) -> None:
+        # the router is the trace origin for fronted traffic: take the
+        # client's X-Trace-Id when present, else the Router mints one
+        trace_id = headers.get("x-trace-id") or None
         body = await self._read_body(reader, writer, headers)
         if body is None:
             return
@@ -702,11 +813,12 @@ class RouterApp:
         deadline = float(deadline) if deadline is not None else None
         if bool(body.get("stream", True)):
             await self._stream_sse(reader, writer, prompt, max_tokens,
-                                   deadline)
+                                   deadline, trace_id)
             return
         try:
             out = await self.router.complete(prompt, max_tokens,
-                                             deadline_s=deadline)
+                                             deadline_s=deadline,
+                                             trace_id=trace_id)
         except ShedError as e:
             writer.write(_json_response(
                 429 if e.cause in ("queue_full", "retries_exhausted")
@@ -715,8 +827,9 @@ class RouterApp:
         writer.write(_json_response(200, out))
 
     async def _stream_sse(self, reader, writer, prompt, max_tokens,
-                          deadline) -> None:
-        agen = self.router.stream(prompt, max_tokens, deadline_s=deadline)
+                          deadline, trace_id=None) -> None:
+        agen = self.router.stream(prompt, max_tokens, deadline_s=deadline,
+                                  trace_id=trace_id)
         # shed BEFORE the first event maps to an HTTP status (the client
         # has seen nothing yet); after that it becomes an SSE error event
         try:

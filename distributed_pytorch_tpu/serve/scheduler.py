@@ -71,8 +71,10 @@ import time
 from typing import Optional
 
 from distributed_pytorch_tpu.engine.decode import Retired
+from distributed_pytorch_tpu.obs import trace as obs_trace
 from distributed_pytorch_tpu.ops.block_pool import NoFreeBlocks
-from distributed_pytorch_tpu.serve.metrics import ServeMetrics
+from distributed_pytorch_tpu.serve.metrics import (ServeMetrics,
+                                                   engine_build_info)
 
 
 class ShedError(RuntimeError):
@@ -119,6 +121,15 @@ class _Request:
     budget_total: int = 0
     resumed: bool = False
     served: int = 0
+    # request tracing (obs/trace.py): the X-Trace-Id the server parsed
+    # (or minted); spans are emitted at TERMINAL events from timestamps
+    # the latency histograms already collect, so tracing adds nothing to
+    # the per-token path. first_tok_at splits prefill from decode;
+    # adm_prefix/adm_prefilled are the last admission's cache accounting.
+    trace_id: Optional[str] = None
+    first_tok_at: Optional[float] = None
+    adm_prefix: int = 0
+    adm_prefilled: int = 0
 
 
 class RequestHandle:
@@ -247,6 +258,15 @@ class Scheduler:
         self.metrics.register_gauge(
             "serve_prefix_hit_rate", lambda: self.engine.prefix_hit_rate,
             "lifetime fraction of prompt tokens served from cached blocks")
+        # provenance: the engine's serving-relevant config as a
+        # Prometheus info gauge (and in the bench JSON via summary())
+        self.metrics.set_build_info(**engine_build_info(engine))
+
+    @property
+    def tracer(self) -> obs_trace.TraceRecorder:
+        """The process-default span recorder (resolved per call so tests
+        can swap rings after construction)."""
+        return obs_trace.get_recorder()
 
     # ------------------------------------------------------------------
     # caller API (event-loop thread only)
@@ -266,10 +286,13 @@ class Scheduler:
         self._exec.shutdown(wait=True)
 
     def submit(self, prompt, max_new_tokens: int, *,
-               deadline_s: Optional[float] = None) -> RequestHandle:
+               deadline_s: Optional[float] = None,
+               trace_id: Optional[str] = None) -> RequestHandle:
         """Enqueue a request (FCFS). Raises `ShedError` immediately when
         the admission queue is at its bound or the scheduler is stopping —
-        backpressure is explicit, the caller maps it to HTTP 429/503."""
+        backpressure is explicit, the caller maps it to HTTP 429/503.
+        `trace_id` hangs the request's lifecycle spans (queue / prefill /
+        decode / retire) on an end-to-end trace (obs/trace.py)."""
         if self._failed is not None:
             raise ShedError("engine_error", str(self._failed))
         if self._stopping:
@@ -290,7 +313,7 @@ class Scheduler:
                        max_new=max_new_tokens, deadline_s=deadline_s,
                        submitted_at=time.perf_counter(), handle=None,
                        orig_prompt_len=len(prompt),
-                       budget_total=max_new_tokens)
+                       budget_total=max_new_tokens, trace_id=trace_id)
         req.handle = RequestHandle(self, req)
         self._pending.add(req.handle)
         self._queue.append(req)
@@ -362,11 +385,41 @@ class Scheduler:
         token is an ITL sample."""
         if req.served == 0:
             self.metrics.ttft.observe(now - req.submitted_at)
+            req.first_tok_at = now
         else:
             self.metrics.itl.observe(now - req.last_tok_at)
         req.last_tok_at = now
         self.metrics.inc("tokens_out")
         req.handle._push_token(tok)
+
+    def _trace_terminal(self, req: _Request, now: float,
+                        outcome: str, **attrs) -> None:
+        """Emit the request's lifecycle spans onto its trace, built from
+        the timestamps already collected for the latency histograms —
+        queue wait (submit -> admit), chunked prefill (admit -> first
+        token), decode (first token -> retirement), and the terminal
+        event. Runs once per request at a terminal transition, never on
+        the token path; a disabled recorder makes it one branch."""
+        tr = self.tracer
+        if not tr.enabled or req.trace_id is None:
+            return
+        tid = req.trace_id
+        adm = req.admitted_at if req.admitted_at is not None else now
+        tr.add("sched.queue", tid, t0=req.submitted_at,
+               dur=max(0.0, adm - req.submitted_at), cat="sched",
+               resumed=req.resumed, prompt_len=req.orig_prompt_len)
+        if req.admitted_at is not None:
+            first = req.first_tok_at if req.first_tok_at is not None \
+                else now
+            tr.add("sched.prefill", tid, t0=adm,
+                   dur=max(0.0, first - adm), cat="sched",
+                   prefix_hit=req.adm_prefix, prefilled=req.adm_prefilled)
+        if req.first_tok_at is not None:
+            tr.add("sched.decode", tid, t0=req.first_tok_at,
+                   dur=max(0.0, now - req.first_tok_at), cat="sched",
+                   tokens=req.served)
+        tr.event(f"sched.{outcome}", tid, t=now, cat="sched",
+                 tokens=req.served, **attrs)
 
     def _request_cancel(self, req: _Request) -> None:
         if req.cancelled or req.handle.retired is not None \
@@ -380,6 +433,8 @@ class Scheduler:
                 pass
             else:
                 self.metrics.inc("cancelled")
+                self._trace_terminal(req, time.perf_counter(), "retire",
+                                     reason="cancelled")
                 req.handle._push_done(Retired(
                     tokens=list(req.prompt), reason="cancelled",
                     prompt_len=self._caller_prompt_len(req, req.prompt)))
@@ -398,6 +453,8 @@ class Scheduler:
             if ret is None:                    # retired before we got here
                 continue
             self.metrics.retired("cancelled")
+            self._trace_terminal(req, time.perf_counter(), "retire",
+                                 reason="cancelled")
             req.handle._push_done(ret)
         # keep not-yet-admitted flagged requests for the next pass (the
         # admission wave resolves them); drop anything already finished
@@ -417,6 +474,7 @@ class Scheduler:
             if not req.resumed and req.deadline_s is not None \
                     and now - req.submitted_at > req.deadline_s:
                 self.metrics.shed("deadline")
+                self._trace_terminal(req, now, "shed", cause="deadline")
                 req.handle._push_error(ShedError(
                     "deadline",
                     f"queued {now - req.submitted_at:.3f}s > deadline "
@@ -467,6 +525,8 @@ class Scheduler:
                     continue
                 req.seq_id = adm.seq_id
                 req.admitted_at = now
+                req.adm_prefix, req.adm_prefilled = (adm.prefix_len,
+                                                     adm.prefilled)
                 self.metrics.inc("admitted")
                 self.metrics.inc("prefix_hit_tokens", adm.prefix_len)
                 self.metrics.inc("prefix_miss_tokens", adm.prefilled)
@@ -505,6 +565,8 @@ class Scheduler:
                 self.metrics.stall(now - t0)
             req.seq_id = adm.seq_id
             req.admitted_at = now
+            req.adm_prefix, req.adm_prefilled = (adm.prefix_len,
+                                                 adm.prefilled)
             # last_tok_at is NOT reset here: _emit_token stamps it, and a
             # resumed request's next ITL sample should span the whole
             # client-visible preemption gap
@@ -528,6 +590,7 @@ class Scheduler:
         # a resumed request's final record reports the caller-visible
         # prompt boundary, not the resubmitted tokens-so-far prompt
         ret.prompt_len = self._caller_prompt_len(req, ret.tokens)
+        self._trace_terminal(req, now, "retire", reason=ret.reason)
         req.handle._push_done(ret)
 
     def _requeue_preempted(self, req: _Request, ret: Retired) -> bool:
@@ -542,8 +605,12 @@ class Scheduler:
             self.metrics.retired("cancelled")
             ret.reason = "cancelled"
             ret.prompt_len = self._caller_prompt_len(req, ret.tokens)
+            self._trace_terminal(req, time.perf_counter(), "retire",
+                                 reason="cancelled")
             req.handle._push_done(ret)
             return False
+        self.tracer.event("sched.preempted", req.trace_id, cat="sched",
+                          tokens=req.served)
         req.prompt = list(ret.tokens)
         # served < budget_total always holds here: the engine retires on
         # 'budget' (not 'preempted') the step the budget is reached
@@ -619,6 +686,9 @@ class Scheduler:
             # shed immediately instead of queueing into a dead loop.
             self._failed = EngineError(exc)
             for handle in list(self._pending):
+                self.tracer.event("sched.engine_error",
+                                  handle._req.trace_id, cat="sched",
+                                  error=repr(exc)[:200])
                 handle._push_error(self._failed)
             self._live.clear()
             self._queue.clear()
@@ -634,6 +704,8 @@ class Scheduler:
             self._live.clear()
             for req in self._queue:
                 self.metrics.shed("shutdown")
+                self._trace_terminal(req, time.perf_counter(), "shed",
+                                     cause="shutdown")
                 req.handle._push_error(
                     ShedError("shutdown", "scheduler stopped"))
             self._queue.clear()

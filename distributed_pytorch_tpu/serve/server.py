@@ -23,6 +23,21 @@ Endpoints:
   and live streams retire. Poll healthz until `drained` is true, then
   replace the process — zero in-flight streams lost.
 
+Observability plane (ISSUE 9):
+* Every completion carries a trace id — the `X-Trace-Id` request header
+  when present (the router tier sends one so a failed-over stream is ONE
+  trace), else minted here. Lifecycle spans (queue wait, chunked
+  prefill, decode, retire — serve/scheduler.py) land in the process
+  trace ring; the final payload (SSE done event / JSON body) carries the
+  id and a compact span summary, and `GET /debug/trace/<id>` replays the
+  full set (`?fmt=chrome` for a Perfetto-loadable file).
+* `GET /debug/timeline` — the engine's step-level flight recorder: the
+  last N fused steps' `{step_ms, n_live, prefill_tokens, emitted,
+  blocks_in_use, preemptions}` records (`?n=` bounds the count).
+* `POST /admin/profile?duration_ms=N` — on-demand `jax.profiler` capture
+  on a live replica (obs/profile.py, output under `runs/.../profile`);
+  one capture at a time — a concurrent request gets 409.
+
 Client disconnects matter at decode timescales: a dropped SSE consumer
 must not hold a slot for its remaining budget. The completion handler
 watches the connection's read side concurrently with the token stream —
@@ -37,20 +52,25 @@ from __future__ import annotations
 
 import asyncio
 import json
+import time
+import urllib.parse
 from typing import Optional
 
+from distributed_pytorch_tpu.obs import profile as obs_profile
+from distributed_pytorch_tpu.obs import trace as obs_trace
 from distributed_pytorch_tpu.serve.scheduler import (RequestHandle,
                                                      Scheduler, ShedError)
 
 _MAX_HEADER_BYTES = 64 * 1024
 _MAX_BODY_BYTES = 8 * 1024 * 1024
+_MAX_PROFILE_MS = 60_000.0
 
 
 def _response(status: int, body: bytes, content_type: str,
               extra: str = "") -> bytes:
     reason = {200: "OK", 400: "Bad Request", 404: "Not Found",
               405: "Method Not Allowed", 408: "Request Timeout",
-              413: "Payload Too Large",
+              409: "Conflict", 413: "Payload Too Large",
               429: "Too Many Requests", 500: "Internal Server Error",
               503: "Service Unavailable"}.get(status, "OK")
     return (f"HTTP/1.1 {status} {reason}\r\n"
@@ -74,15 +94,22 @@ class ServeApp:
     def __init__(self, scheduler: Scheduler, *, host: str = "127.0.0.1",
                  port: int = 8000, encoder=None,
                  default_max_tokens: int = 64,
-                 request_timeout_s: float = 30.0):
+                 request_timeout_s: float = 30.0,
+                 profile_dir: Optional[str] = None):
         self.scheduler = scheduler
         self.host = host
         self.port = port
         self.encoder = encoder            # tiktoken-like, or None (ids only)
         self.default_max_tokens = default_max_tokens
         self.request_timeout_s = request_timeout_s
+        self.profile_dir = profile_dir    # /admin/profile output (default
+                                          # runs/serve/profile)
         self._server: Optional[asyncio.base_events.Server] = None
         self._writers: set[asyncio.StreamWriter] = set()
+
+    @property
+    def tracer(self) -> obs_trace.TraceRecorder:
+        return obs_trace.get_recorder()
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -158,7 +185,10 @@ class ServeApp:
             if len(parts) < 2:
                 writer.write(_json_response(400, {"error": "bad request"}))
                 return
-            method, path = parts[0].upper(), parts[1].split("?")[0]
+            method, fullpath = parts[0].upper(), parts[1]
+            path, _, qs = fullpath.partition("?")
+            query = {k: v[0] for k, v in
+                     urllib.parse.parse_qs(qs).items()}
             headers = {}
             for line in header_lines:
                 if ":" in line:
@@ -171,8 +201,14 @@ class ServeApp:
                 body = self.scheduler.metrics.render_prometheus().encode()
                 writer.write(_response(
                     200, body, "text/plain; version=0.0.4; charset=utf-8"))
+            elif method == "GET" and path.startswith("/debug/trace/"):
+                writer.write(self._debug_trace(path, query))
+            elif method == "GET" and path == "/debug/timeline":
+                writer.write(self._debug_timeline(query))
             elif method == "POST" and path == "/v1/completions":
                 await self._completions(reader, writer, headers)
+            elif method == "POST" and path == "/admin/profile":
+                await self._admin_profile(writer, query)
             elif method == "POST" and path == "/admin/drain":
                 self.scheduler.drain()
                 writer.write(_json_response(200, {
@@ -180,7 +216,9 @@ class ServeApp:
                     "live_slots": self.scheduler.engine.n_live,
                     "queue_depth": self.scheduler.queue_depth}))
             elif path in ("/healthz", "/metrics", "/v1/completions",
-                          "/admin/drain"):
+                          "/admin/drain", "/admin/profile",
+                          "/debug/timeline") \
+                    or path.startswith("/debug/trace/"):
                 writer.write(_json_response(405, {"error": "method not "
                                                            "allowed"}))
             else:
@@ -214,9 +252,77 @@ class ServeApp:
             body["failed"] = str(sched.failed)
         return _json_response(200 if ready else 503, body)
 
+    def _debug_trace(self, path: str, query: dict) -> bytes:
+        """`GET /debug/trace/<id>`: the request's recorded spans.
+        Default is the compact summary (offsets in ms from the trace's
+        first span); `?fmt=chrome` returns a Chrome-trace/Perfetto JSON
+        file for that trace alone."""
+        tid = path.rsplit("/", 1)[1]
+        spans = self.tracer.spans_for(tid)
+        if not spans:
+            return _json_response(404, {"error": f"no spans for trace "
+                                                 f"{tid!r} (expired from "
+                                                 f"the ring, or unknown)"})
+        if query.get("fmt") in ("chrome", "perfetto"):
+            return _json_response(200, self.tracer.to_chrome(tid))
+        return _json_response(200, {"trace_id": tid,
+                                    "n_spans": len(spans),
+                                    "spans": self.tracer.summary(tid)})
+
+    def _debug_timeline(self, query: dict) -> bytes:
+        """`GET /debug/timeline[?n=512]`: the engine flight recorder's
+        last n per-step records — the post-hoc ITL-spike diagnosis feed
+        the aggregate histograms can't provide."""
+        fl = getattr(self.scheduler.engine, "flight", None)
+        if fl is None:
+            return _json_response(404, {"error": "engine has no flight "
+                                                 "recorder"})
+        try:
+            n = max(1, int(query.get("n", "512")))
+        except ValueError:
+            return _json_response(400, {"error": "bad n"})
+        return _json_response(200, {
+            "entries": fl.entries(n), "n_steps": fl.total,
+            "dropped": fl.dropped, "capacity": fl.capacity})
+
+    async def _admin_profile(self, writer, query: dict) -> None:
+        """`POST /admin/profile?duration_ms=N`: capture a jax.profiler
+        trace on the live replica. The capture thread sleeps out the
+        window in an executor while the step loop keeps serving; the
+        xplane lands under the configured profile dir."""
+        try:
+            duration_ms = float(query.get("duration_ms", "1000"))
+        except ValueError:
+            writer.write(_json_response(400, {"error": "bad duration_ms"}))
+            return
+        if not 0 < duration_ms <= _MAX_PROFILE_MS:
+            writer.write(_json_response(
+                400, {"error": f"duration_ms must be in "
+                               f"(0, {_MAX_PROFILE_MS:.0f}]"}))
+            return
+        loop = asyncio.get_running_loop()
+        try:
+            out_dir = await loop.run_in_executor(
+                None, lambda: obs_profile.capture(
+                    duration_ms, self.profile_dir, run="serve"))
+        except obs_profile.ProfilerBusy as e:
+            writer.write(_json_response(409, {"error": str(e)}))
+            return
+        except Exception as e:  # noqa: BLE001 — profiler backend errors
+            writer.write(_json_response(
+                500, {"error": f"profiler failed: {e!r}"}))
+            return
+        writer.write(_json_response(200, {
+            "profile_dir": out_dir, "duration_ms": duration_ms}))
+
     # ------------------------------------------------------------------
 
     async def _completions(self, reader, writer, headers) -> None:
+        # request receipt is the replica-side trace origin: the incoming
+        # X-Trace-Id (the router's, so a failover stays ONE trace) or a
+        # freshly minted id when this replica is unfronted
+        t_req = time.perf_counter()
+        trace_id = headers.get("x-trace-id") or obs_trace.new_trace_id()
         try:
             n = int(headers.get("content-length", "0"))
         except ValueError:
@@ -264,31 +370,54 @@ class ServeApp:
             handle = self.scheduler.submit(
                 prompt, max_tokens,
                 deadline_s=float(deadline) if deadline is not None
-                else None)
+                else None, trace_id=trace_id)
         except ShedError as e:
             writer.write(_json_response(
                 429 if e.cause == "queue_full" else 503,
-                {"error": str(e), "cause": e.cause}))
+                {"error": str(e), "cause": e.cause,
+                 "trace_id": trace_id}))
             return
 
         if stream:
-            await self._stream_sse(reader, writer, handle)
+            await self._stream_sse(reader, writer, handle, trace_id,
+                                   t_req)
         else:
             try:
                 ret = await handle.result()
             except ShedError as e:
                 writer.write(_json_response(429, {"error": str(e),
-                                                  "cause": e.cause}))
+                                                  "cause": e.cause,
+                                                  "trace_id": trace_id}))
                 return
             except Exception as e:         # engine death: explicit 500
                 writer.write(_json_response(500, {
                     "error": str(e),
-                    "cause": getattr(e, "cause", "internal")}))
+                    "cause": getattr(e, "cause", "internal"),
+                    "trace_id": trace_id}))
                 return
-            writer.write(_json_response(200, {
-                "tokens": ret.tokens[ret.prompt_len:],
-                "text": self._decode(ret.tokens[ret.prompt_len:]),
-                "reason": ret.reason, "n_prompt": ret.prompt_len}))
+            body = {"tokens": ret.tokens[ret.prompt_len:],
+                    "text": self._decode(ret.tokens[ret.prompt_len:]),
+                    "reason": ret.reason, "n_prompt": ret.prompt_len,
+                    "trace_id": trace_id}
+            spans = self._close_http_span(trace_id, t_req,
+                                          len(handle.tokens))
+            if spans:
+                body["spans"] = spans
+            writer.write(_json_response(200, body))
+
+    def _close_http_span(self, trace_id: str, t_req: float,
+                         streamed: int) -> list[dict]:
+        """Record the replica-HTTP span (request receipt -> now) and
+        return the request's compact span summary, offsets relative to
+        t_req — the base a dispatching router re-anchors on its own
+        clock to stitch one cross-process timeline."""
+        tr = self.tracer
+        if not tr.enabled:
+            return []
+        tr.add("replica.http", trace_id, t0=t_req,
+               dur=time.perf_counter() - t_req, cat="server",
+               streamed=streamed)
+        return tr.summary(trace_id, base=t_req)
 
     def _decode(self, toks: list[int]) -> Optional[str]:
         if self.encoder is None:
@@ -298,8 +427,8 @@ class ServeApp:
         except Exception:
             return None
 
-    async def _stream_sse(self, reader, writer,
-                          handle: RequestHandle) -> None:
+    async def _stream_sse(self, reader, writer, handle: RequestHandle,
+                          trace_id: str, t_req: float) -> None:
         writer.write(b"HTTP/1.1 200 OK\r\n"
                      b"Content-Type: text/event-stream\r\n"
                      b"Cache-Control: no-cache\r\n"
@@ -343,8 +472,17 @@ class ServeApp:
                 writer.write(self._sse(event))
                 await writer.drain()
             ret = handle.retired
-            writer.write(self._sse({"done": True, "reason": ret.reason,
-                                    "n_tokens": len(handle.tokens)}))
+            done_ev = {"done": True, "reason": ret.reason,
+                       "n_tokens": len(handle.tokens),
+                       "trace_id": trace_id}
+            # the span summary rides the done event so the router (or any
+            # client) gets the replica-side timeline without a second
+            # round-trip — offsets are relative to request receipt
+            spans = self._close_http_span(trace_id, t_req,
+                                          len(handle.tokens))
+            if spans:
+                done_ev["spans"] = spans
+            writer.write(self._sse(done_ev))
             writer.write(b"data: [DONE]\n\n")
             await writer.drain()
         except (ConnectionError, asyncio.CancelledError):

@@ -310,8 +310,16 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
     flops_per_step = M.step_flops(model_cfg, tokens_per_step, T)
     peak = M.peak_flops_per_chip()
 
+    # on-demand device profiling routed through the shared obs/profile.py
+    # wrapper (the old hardcoded "profile_trace" dir is gone): captures
+    # land under runs/<run>/profile unless --profile_dir says otherwise,
+    # alongside the rest of the run's artifacts
+    prof_dir = None
     if train_cfg.profile and is_main:
-        jax.profiler.start_trace("profile_trace")
+        from distributed_pytorch_tpu.obs import profile as obs_profile
+        prof_dir = obs_profile.start_profile(
+            train_cfg.profile_dir or None, run=train_cfg.file_name)
+        say(f"profiler tracing -> {prof_dir}")
 
     # Training batches are keyed on the iteration number, so a resumed run
     # continues the exact uninterrupted stream (round-1 weak #4: the loader
@@ -434,7 +442,12 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
                 win_t0 = time.perf_counter()       # ckpt time isn't step time
 
     if train_cfg.profile and is_main:
-        jax.profiler.stop_trace()
+        from distributed_pytorch_tpu.obs import profile as obs_profile
+        obs_profile.stop_profile()
+        say(f"profiler trace -> {prof_dir} (open with Perfetto, or "
+            f"scripts/profile_step.py --analyze_only --trace_dir "
+            f"{prof_dir})")
+        stats["profile_dir"] = prof_dir
 
     ckpt.wait_for_saves()  # async interval saves must be durable
 

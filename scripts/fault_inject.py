@@ -145,6 +145,32 @@ async def _healthz(port: int, timeout=2.0) -> tuple[int, dict]:
     return int(head.split(b" ")[1]), json.loads(body or b"{}")
 
 
+async def _fetch_timeline(port: int, path: str, timeout=3.0) -> int:
+    """Pull a replica's step-level flight recorder (`GET
+    /debug/timeline`) and persist it as JSONL in the log dir — the
+    post-hoc record of what the engine was doing around the injected
+    fault. Best-effort: returns the entry count (0 on any failure)."""
+    try:
+        reader, writer = await asyncio.wait_for(
+            asyncio.open_connection("127.0.0.1", port), timeout)
+        try:
+            writer.write(b"GET /debug/timeline?n=4096 HTTP/1.1\r\n"
+                         b"Host: h\r\n\r\n")
+            await writer.drain()
+            raw = await asyncio.wait_for(reader.read(), timeout)
+        finally:
+            writer.close()
+        body = json.loads(raw.partition(b"\r\n\r\n")[2] or b"{}")
+        entries = body.get("entries", [])
+        if entries:
+            with open(path, "w") as f:
+                for e in entries:
+                    f.write(json.dumps(e) + "\n")
+        return len(entries)
+    except Exception:  # noqa: BLE001 — artifacts never fail the harness
+        return 0
+
+
 async def _wait_up(port: int, timeout_s: float = 120.0) -> None:
     deadline = time.perf_counter() + timeout_s
     while time.perf_counter() < deadline:
@@ -310,6 +336,14 @@ async def _run_leg(args, n_replicas: int, inject: bool, log_dir: str,
         snapshot = router.snapshot()
         metrics = router.metrics.summary()
         await router.stop()
+        # persist each live replica's step timeline before teardown —
+        # the flight-recorder view of the drive (and, on the restarted
+        # victim, of the post-rejoin traffic)
+        artifacts = {}
+        for i, r in enumerate(reps):
+            p = os.path.join(log_dir, f"{tag}_replica{i}_timeline.jsonl")
+            if await _fetch_timeline(r.port, p):
+                artifacts[f"replica{i}_timeline"] = p
     finally:
         for r in reps:
             r.terminate()
@@ -336,6 +370,7 @@ async def _run_leg(args, n_replicas: int, inject: bool, log_dir: str,
             "ttft_p99_ms": metrics["ttft"].get("p99_ms"),
             "itl_p50_ms": metrics["itl"].get("p50_ms"),
             "itl_p99_ms": metrics["itl"].get("p99_ms"),
+            "artifacts": artifacts,
             "replica_states": snapshot}
 
 
@@ -358,6 +393,16 @@ async def _amain(args) -> dict:
     # lossless (no shed at all — admission moved, nothing dropped)
     out["ok"] = (out["failed"] == 0 and out["parity_mismatches"] == 0
                  and (args.mode != "drain" or out["shed"] == 0))
+    # the router runs IN this process: its dispatch/failover spans (one
+    # trace per request, failed-over streams stitched) dump here too
+    try:
+        from distributed_pytorch_tpu.obs import trace as obs_trace
+        rec = obs_trace.get_recorder()
+        if len(rec):
+            out.setdefault("artifacts", {})["router_trace"] = \
+                rec.dump_jsonl(os.path.join(log_dir, "router_trace.jsonl"))
+    except Exception:  # noqa: BLE001 — artifacts never fail the harness
+        pass
     # the ~linear-scaling criterion needs a core per replica process +
     # one for the driver; report the host honestly so a 1-core CI box's
     # ~1x never reads as a scaling failure of the router itself

@@ -97,9 +97,18 @@ def main():
     ap.add_argument("--attn", default="auto")
     ap.add_argument("--remat", action="store_true")
     ap.add_argument("--loss", default="fused")
-    ap.add_argument("--trace_dir", default="profile_trace")
+    ap.add_argument("--trace_dir", default="",
+                    help="default: the obs/profile.py convention "
+                         "runs/profile_step/profile")
     ap.add_argument("--analyze_only", action="store_true")
     args = ap.parse_args()
+    if not args.trace_dir:
+        import os as _os
+        import sys as _sys
+        _sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+            _os.path.abspath(__file__))))
+        from distributed_pytorch_tpu.obs.profile import profile_dir
+        args.trace_dir = profile_dir("profile_step")
 
     if not args.analyze_only:
         print(f"device: {jax.devices()[0].device_kind}", flush=True)

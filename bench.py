@@ -904,6 +904,11 @@ def run_bench(platform: str, only_recipe: str | None = None) -> dict:
             # sync every 4 steps: host round-trips overlap device compute
             # (train/loop.py sync discipline), like a real pod run would
             log_interval=4, eval=False, save_model=False, save_stats=False,
+            # the train flight recorder dumps the leg's step-phase
+            # timeline to runs/bench_train_<recipe>/train_timeline.jsonl
+            # (referenced from "artifacts" below — the round-14 serve-leg
+            # convention)
+            file_name=f"bench_train_{recipe}",
             compute_dtype="bfloat16")
         stats = train(model_cfg, train_cfg,
                       log=lambda s: print(f"[{recipe}] {s}", file=sys.stderr))
@@ -911,6 +916,13 @@ def run_bench(platform: str, only_recipe: str | None = None) -> dict:
                    round(stats["median_tokens_per_sec"] / n_dev, 1),
                "mfu": stats.get("median_mfu"),
                "peak_hbm_gb": stats.get("peak_hbm_gb")}
+        # memplan predicted-vs-measured HBM rows + the step timeline: the
+        # first-TPU-window "validate memplan against peak_bytes_in_use"
+        # record rides every train leg's JSON
+        if stats.get("memplan"):
+            out["memplan"] = stats["memplan"]
+        if stats.get("artifacts"):
+            out["artifacts"] = stats["artifacts"]
         if model_cfg.moe:
             # dropped assignments (scatter's silent GShard drops; 0 for
             # dense/grouped) + how much the dispatch overspends FLOPs —
@@ -937,6 +949,10 @@ def run_bench(platform: str, only_recipe: str | None = None) -> dict:
     extra = {"n_chips": n_dev, "recipe": recipe,
              "device": jax.devices()[0].device_kind,
              "per_chip_batch": per_chip,
+             # leg artifacts (train_timeline.jsonl) at the top level,
+             # matching the serve legs' "artifacts" key
+             **({"artifacts": headline["artifacts"]}
+                if results[recipe].get("artifacts") else {}),
              "overlap": os.environ.get("OVERLAP", "auto"),
              "preset": os.environ.get("BENCH_PRESET", "")
                        or ("gpt2_124m_moe" if os.environ.get("BENCH_MOE")

@@ -291,6 +291,17 @@ class TrainConfig:
     profile_dir: str = ""            # capture output dir; "" = the
                                      # obs/profile.py convention
                                      # runs/<file_name>/profile
+    # --- training observability (train/telemetry.py, ISSUE 10) ---
+    telemetry: bool = True           # train flight recorder + step-phase
+                                     # timers; False = disabled mode (one
+                                     # attribute check/step, no alloc)
+    metrics_port: int = -1           # live /metrics+/debug/timeline+
+                                     # /healthz HTTP thread on the main
+                                     # host: -1 off, 0 ephemeral port
+                                     # (logged), >0 fixed port
+    anomaly: str = "warn"            # loss/grad guard: 'skip' withholds
+                                     # the optimizer update on a NaN/inf
+                                     # step, 'warn' records only, 'off'
 
     def __post_init__(self):
         assert self.parallelism in PARALLELISM_RECIPES, \
@@ -306,6 +317,8 @@ class TrainConfig:
             f"unknown overlap mode {self.overlap!r}"
         assert self.optimizer in ("adamw", "lion", "adafactor"), \
             f"unknown optimizer {self.optimizer!r}"
+        assert self.anomaly in ("skip", "warn", "off"), \
+            f"unknown anomaly mode {self.anomaly!r}"
 
 
 # ---------------------------------------------------------------------------
@@ -317,7 +330,7 @@ _BOOL_FLAGS = {
     # reference store_true flags (single-gpu/train.py:176-180)
     "moe", "aux_free", "eval", "save_model", "act_recomp",
     # new
-    "resume", "profile", "save_stats",
+    "resume", "profile", "save_stats", "telemetry",
 }
 
 

@@ -17,6 +17,13 @@ Three tools, one package (ISSUE 9):
 * `obs.profile` — the one shared `jax.profiler` wrapper (train loop,
   serve `POST /admin/profile`, bench legs) with a `runs/<run>/profile`
   output convention, replacing the hardcoded train-loop trace dir.
+
+The TRAINING side (ISSUE 10) builds on the same primitives:
+`train/telemetry.py` wraps a FlightRecorder ring with step-phase
+records ({it, loss, grad_norm, step_ms, data_ms, sync_ms, ckpt_ms}),
+a Prometheus registry on serve/metrics.py machinery, the loss/grad
+anomaly monitor, and an opt-in live HTTP endpoint — dumped to
+`runs/<run>/train_timeline.jsonl` like the serve legs' timelines.
 """
 
 from distributed_pytorch_tpu.obs.flight import FlightRecorder

@@ -8,12 +8,23 @@ micro-step loop with autocast/scaler bookkeeping); the host meanwhile
 prefetches the next batch from the memmap (reference train.py:343 prefetch).
 Logging: loss, dt, tokens/sec/chip and MFU (BASELINE.json metrics; the
 reference logs only ms/step + reserved GB, train.py:354-359).
+
+Observability (ISSUE 10, train/telemetry.py): per logged step the loop
+feeds a flight-recorder ring ({it, loss, grad_norm, step_ms, data_ms,
+sync_ms, ckpt_ms, tokens_per_s, mfu} -> runs/<run>/train_timeline.jsonl),
+optionally serves it live over HTTP (`--metrics_port`), samples the
+per-device HBM watermark against the memplan prediction, and drains the
+loss/grad anomaly monitor — all at the existing sync boundaries, so the
+per-step hot path stays device-async ('skip' anomaly handling itself is
+compiled into the step, train/step.py). stats.json is written atomically
+and refreshed at every checkpoint boundary.
 """
 
 from __future__ import annotations
 
 import contextlib
 import dataclasses
+import json
 import os
 import signal
 import threading
@@ -29,7 +40,9 @@ from distributed_pytorch_tpu.models.gpt import count_params
 from distributed_pytorch_tpu.parallel import sharding as shd
 from distributed_pytorch_tpu.parallel.mesh import mesh_for
 from distributed_pytorch_tpu.train import checkpoint as ckpt
+from distributed_pytorch_tpu.train import memplan
 from distributed_pytorch_tpu.train import metrics as M
+from distributed_pytorch_tpu.train import telemetry
 from distributed_pytorch_tpu.train.state import create_train_state
 from distributed_pytorch_tpu.train.step import make_eval_step, make_train_step
 
@@ -202,6 +215,46 @@ def _agree_stop(local_flag: bool) -> bool:
     return bool(np.asarray(flags).any())
 
 
+def _atomic_write_json(path: str, obj: dict) -> None:
+    """tmp + rename so a reader — or a preemption mid-write — never
+    sees a torn stats.json (the write is refreshed at every checkpoint
+    boundary, not just at exit)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1)
+    os.replace(tmp, path)
+
+
+def _refresh_memplan(stats: dict, predicted_gb, breakdown) -> None:
+    """(Re)sample the per-device HBM watermark against the memplan
+    prediction into stats['memplan'] — the ROADMAP validation record:
+    `{memplan_predicted_gb, measured_peak_gb, delta}` per device."""
+    stats["memplan"] = {
+        "predicted_gb": round(predicted_gb, 3)
+        if predicted_gb is not None else None,
+        "breakdown_gb": breakdown,
+        "devices": memplan.watermark_report(predicted_gb),
+    }
+
+
+def _write_stats_files(stats: dict, model_cfg: LLMConfig,
+                       train_cfg: TrainConfig, ckpt_root: str,
+                       run_dir: str, predicted_gb, breakdown) -> str:
+    """Persist the run record atomically to BOTH homes: the checkpoint
+    dir (the reference `<name>_stats.pt` contract, train resume
+    tooling) and runs/<run>/ next to train_timeline.jsonl (the round-14
+    artifact convention CI uploads)."""
+    _refresh_memplan(stats, predicted_gb, breakdown)
+    record = {k: v for k, v in stats.items() if k != "state"}
+    record["model_config"] = dataclasses.asdict(model_cfg)
+    record["train_config"] = dataclasses.asdict(train_cfg)
+    path = os.path.join(ckpt_root, "stats.json")
+    _atomic_write_json(path, record)
+    _atomic_write_json(os.path.join(run_dir, "stats.json"), record)
+    return path
+
+
 def estimate_loss(eval_step, state, loaders: dict, eval_iters: int) -> dict:
     """Mean eval loss over eval_iters batches per split (reference
     estimate_loss, single-gpu/train.py:280-293). Eval batches are keyed on
@@ -310,6 +363,46 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
     flops_per_step = M.step_flops(model_cfg, tokens_per_step, T)
     peak = M.peak_flops_per_chip()
 
+    # ---- training observability (train/telemetry.py, ISSUE 10) ----------
+    # All feeding happens at the existing sync boundaries (the drain
+    # below already blocks on the queued metric futures), so the
+    # per-step hot path stays device-async; telemetry=False reduces
+    # every call site to one attribute check, no allocation.
+    tel = telemetry.TrainTelemetry(
+        run=train_cfg.file_name, enabled=train_cfg.telemetry,
+        anomaly=train_cfg.anomaly)
+    run_dir = os.path.join("runs", train_cfg.file_name)
+    timeline_path = os.path.join(run_dir, "train_timeline.jsonl")
+    # price the config ACTUALLY in flight once up front; the
+    # peak_bytes_in_use watermark is sampled at boundaries below and
+    # the delta lands in the timeline, stats.json, and bench JSON
+    try:
+        memplan_pred_gb, memplan_breakdown = \
+            memplan.predicted_train_peak_gb(model_cfg, train_cfg, sizes)
+    except Exception as e:  # noqa: BLE001 — planning never stops a run
+        memplan_pred_gb, memplan_breakdown = None, {"error": repr(e)}
+    # an anomaly event's data-shard coordinates: the loader is
+    # step-keyed, so these + batch_step reproduce the poisoned batch
+    data_coords = {"dataset": train_cfg.dataset, "seed": train_cfg.seed,
+                   "dp_shards": sizes.get("data", 1)}
+    tel.metrics.set_build_info(
+        run=train_cfg.file_name, recipe=train_cfg.parallelism,
+        model=f"L{model_cfg.n_layer}xD{model_cfg.n_embd}-{model_cfg.attn}",
+        tokens_per_step=tokens_per_step, grad_accum=grad_accum,
+        anomaly=train_cfg.anomaly, jax=jax.__version__)
+    tel_server = None
+    if train_cfg.metrics_port >= 0 and is_main and tel.enabled:
+        # opt-in live endpoint (main host only): a multi-hour TPU run
+        # is inspectable mid-flight without killing it. Daemon thread —
+        # an exception path that skips stop() cannot hold the process.
+        tel_server = telemetry.TelemetryServer(
+            tel, port=train_cfg.metrics_port).start()
+        stats["telemetry_port"] = tel_server.port
+        say(f"telemetry: http://127.0.0.1:{tel_server.port}/metrics "
+            f"(step records at /debug/timeline, liveness at /healthz)")
+    elif train_cfg.metrics_port >= 0 and is_main:
+        say("metrics_port set but --no-telemetry: endpoint not started")
+
     # on-demand device profiling routed through the shared obs/profile.py
     # wrapper (the old hardcoded "profile_trace" dir is gone): captures
     # land under runs/<run>/profile unless --profile_dir says otherwise,
@@ -336,6 +429,7 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
     x, y = train_loader.next_batch(step=start_step)
     pending: list = []                         # metric futures since last sync
     win_t0 = time.perf_counter()
+    win_data_s = 0.0                           # host batch-fetch time this window
     stopped_early = False
     with _graceful_stop() as stop:
         for it in range(start_step, train_cfg.max_iters + 1):
@@ -373,6 +467,8 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
                                     "val": val_loader},
                                    train_cfg.eval_iters)
                 stats["val_losses"].append((it, ev["val"]))
+                if tel.enabled:
+                    tel.metrics.inc("evals")
                 say(f"iter {it}: train {ev['train']:.4f} val {ev['val']:.4f} "
                     f"({time.perf_counter() - t0:.1f}s)")
                 win_t0 = time.perf_counter()       # eval time isn't step time
@@ -380,7 +476,12 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
             state, m = train_step(state, x, y)
             pending.append(m)
             if it < train_cfg.max_iters:  # no wasted sample on the final iter
-                x, y = train_loader.next_batch(step=it + 1)  # host prefetch while device runs
+                if tel.enabled:            # data_ms: the host-side fetch cost
+                    t_d = time.perf_counter()
+                    x, y = train_loader.next_batch(step=it + 1)  # host prefetch while device runs
+                    win_data_s += time.perf_counter() - t_d
+                else:
+                    x, y = train_loader.next_batch(step=it + 1)  # host prefetch while device runs
 
             ckpt_due = bool(train_cfg.ckpt_interval and it
                             and it % train_cfg.ckpt_interval == 0)
@@ -389,11 +490,14 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
             sync_due = (it % train_cfg.log_interval == 0 or ckpt_due
                         or eval_next or it == train_cfg.max_iters)
             if sync_due:
+                t_s0 = time.perf_counter()
                 got = jax.device_get(pending)      # blocks on all queued steps
                 t_now = time.perf_counter()
+                sync_s = t_now - t_s0              # host blocked on the drain
                 dt = (t_now - win_t0) / len(pending)
                 win_t0 = t_now
                 first_window = not stats["train_losses"]
+                win_first_it = it - len(got) + 1   # window is contiguous iters
                 for g in got:
                     stats["train_losses"].append(float(g["loss"]))
                     if "moe_dropped_frac" in g:
@@ -407,14 +511,64 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
                         if peak:
                             stats["mfu"].append(
                                 flops_per_step / dt / (peak * n_chips))
+                # ---- anomaly + telemetry drain: the boundary already --
+                # paid the device sync; everything below is host floats
+                mfu_now = (flops_per_step / dt / (peak * n_chips)
+                           if peak else None)
+                hbm_now = M.device_memory_gb()     # watermark: compile is in
+                for k, g in enumerate(got):        # the first window's sample
+                    it_k = win_first_it + k
+                    loss_k = float(g["loss"])
+                    gn_k = float(g["grad_norm"])
+                    ev = tel.anomalies.observe(
+                        it=it_k, loss=loss_k, grad_norm=gn_k,
+                        skipped=bool(g.get("update_skipped", 0.0)),
+                        coords={**data_coords, "batch_step": it_k})
+                    if ev is not None:
+                        tel.record_anomaly(ev)
+                        stats.setdefault("anomalies", []).append(ev)
+                        say(f"[anomaly] iter {it_k}: {ev['kind']} "
+                            f"(loss {loss_k:.4g}, grad_norm {gn_k:.4g}"
+                            f"{', update skipped' if ev['skipped'] else ''}"
+                            f") — batch from {ev.get('data_coords')}")
+                    if tel.enabled:
+                        rec = {"it": it_k, "loss": loss_k, "grad_norm": gn_k,
+                               "data_ms": round(win_data_s / len(got) * 1e3,
+                                                3)}
+                        if first_window:           # compile-inclusive window:
+                            rec["compile_window"] = True   # no honest step_ms
+                        else:
+                            rec["step_ms"] = round(dt * 1e3, 3)
+                            rec["tokens_per_s"] = round(
+                                tokens_per_step / dt, 1)
+                            if mfu_now is not None:
+                                rec["mfu"] = round(mfu_now, 4)
+                        if k == len(got) - 1:      # boundary record carries
+                            rec["sync_ms"] = round(sync_s * 1e3, 3)  # drain +
+                            if hbm_now:                              # watermark
+                                rec["hbm_gb"] = round(hbm_now, 3)
+                        tel.record_step(**rec)
+                if tel.enabled:
+                    tel.metrics.inc("steps", len(got))
+                    tel.metrics.observe_phases(
+                        step_s=None if first_window else dt,
+                        data_s=win_data_s / len(got), sync_s=sync_s)
+                    tel.last.update(
+                        it=it, loss=float(got[-1]["loss"]),
+                        tokens_per_s=(0.0 if first_window
+                                      else tokens_per_step / dt),
+                        mfu=None if first_window else mfu_now,
+                        hbm_gb=hbm_now)
+                win_data_s = 0.0
                 if it % train_cfg.log_interval == 0:
                     loss = stats["train_losses"][-1]
                     tps = tokens_per_step / dt
                     mfu_s = (f" | mfu "
                              f"{flops_per_step / dt / (peak * n_chips):6.2%}"
                              if peak else "")
-                    hbm = M.device_memory_gb()  # reference reserved-GB print,
-                    hbm_s = f" | hbm {hbm:5.2f}GB" if hbm else ""  # train.py:356
+                    # reference reserved-GB print (train.py:356); hbm_now
+                    # was sampled at this same boundary above
+                    hbm_s = f" | hbm {hbm_now:5.2f}GB" if hbm_now else ""
                     drop_s = ""
                     if stats.get("moe_dropped_frac"):
                         # silent GShard-style drops (scatter mode) become a
@@ -437,6 +591,22 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
                 # visible (ROADMAP async-checkpoint item)
                 stats.setdefault("ckpt_snapshot_ms", []).append(
                     round(ckpt.last_snapshot_ms, 2))
+                if tel.enabled:
+                    tel.metrics.inc("checkpoints")
+                    tel.metrics.observe_phases(
+                        ckpt_s=ckpt.last_snapshot_ms / 1e3)
+                    tel.record_step(event="ckpt", it=it,
+                                    ckpt_ms=round(ckpt.last_snapshot_ms, 2))
+                # refresh the on-disk run record at EVERY checkpoint
+                # boundary (atomic tmp+rename): a preempted or killed
+                # run leaves a usable stats.json + timeline behind, not
+                # only the copy written at exit
+                if train_cfg.save_stats and is_main:
+                    _write_stats_files(stats, model_cfg, train_cfg,
+                                       ckpt_root, run_dir,
+                                       memplan_pred_gb, memplan_breakdown)
+                if tel.enabled and is_main:
+                    tel.dump(timeline_path)
                 say(f"checkpoint (async) -> {path} "
                     f"(snapshot {ckpt.last_snapshot_ms:.0f}ms)")
                 win_t0 = time.perf_counter()       # ckpt time isn't step time
@@ -462,6 +632,12 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
 
     stats["final_loss"] = stats["train_losses"][-1] if stats["train_losses"] else None
     stats["peak_hbm_gb"] = M.device_memory_gb()
+    _refresh_memplan(stats, memplan_pred_gb, memplan_breakdown)
+    if tel.enabled and is_main:
+        # the step-phase timeline next to the rest of the run artifacts
+        stats["artifacts"] = {"train_timeline": tel.dump(timeline_path)}
+    if stats.get("anomalies"):
+        stats["n_anomalies"] = len(stats["anomalies"])
     if stats.get("moe_dropped_frac"):
         # headline number for bench JSON: the steady-state drop fraction
         stats["final_moe_dropped_frac"] = stats["moe_dropped_frac"][-1]
@@ -475,16 +651,16 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
 
     if train_cfg.save_stats and is_main:
         # JSON-persisted run record (the reference's `<name>_stats.pt`,
-        # single-gpu/train.py:361-372, which round 1 let evaporate).
-        import json
-        record = {k: v for k, v in stats.items()}
-        record["model_config"] = dataclasses.asdict(model_cfg)
-        record["train_config"] = dataclasses.asdict(train_cfg)
-        os.makedirs(ckpt_root, exist_ok=True)
-        stats_path = os.path.join(ckpt_root, "stats.json")
-        with open(stats_path, "w") as f:
-            json.dump(record, f, indent=1)
+        # single-gpu/train.py:361-372, which round 1 let evaporate) —
+        # written atomically, and already refreshed at every checkpoint
+        # boundary above so this is only the final state of it.
+        stats_path = _write_stats_files(stats, model_cfg, train_cfg,
+                                        ckpt_root, run_dir,
+                                        memplan_pred_gb, memplan_breakdown)
         say(f"stats -> {stats_path}")
+
+    if tel_server is not None:
+        tel_server.stop()
 
     stats["state"] = state
     return stats

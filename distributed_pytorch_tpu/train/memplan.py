@@ -344,6 +344,47 @@ def plan_decode_slots(model_cfg: LLMConfig, max_len: int, *,
     return best
 
 
+def predicted_train_peak_gb(model_cfg: LLMConfig, train_cfg: TrainConfig,
+                            mesh_sizes: Optional[dict] = None,
+                            ) -> tuple[float, dict]:
+    """Predicted per-device peak for the run configuration ACTUALLY in
+    flight (not the planner's pick): the micro-batch / remat policy /
+    recipe the loop is about to compile, priced by estimate_peak_gb.
+    `mesh_sizes` is the loop's {axis: size} dict (data/seq/expert axes
+    read, missing = 1). This is the "predicted" half of the
+    watermark-vs-memplan delta the ROADMAP validation item needs."""
+    sizes = mesh_sizes or {}
+    policy = model_cfg.act_recomp_policy if model_cfg.act_recomp else "none"
+    return estimate_peak_gb(
+        model_cfg, train_cfg.parallelism, train_cfg.batch_size, policy,
+        dp=sizes.get("data", 1), sp=sizes.get("seq", 1),
+        ep=sizes.get("expert", 1), optimizer=train_cfg.optimizer)
+
+
+def watermark_report(predicted_gb: Optional[float]) -> list[dict]:
+    """Per-device `{device, memplan_predicted_gb, measured_peak_gb,
+    delta}` rows from the live `peak_bytes_in_use` watermark — the
+    record stats.json / bench JSON / the mfu_sweep carry so a hardware
+    window validates the planner constants without re-running anything.
+    Keys are always present; values are None where the backend reports
+    no memory stats (CPU) so the schema is stable across backends."""
+    from distributed_pytorch_tpu.train.metrics import hbm_watermark
+
+    rows = []
+    for d in hbm_watermark():
+        peak = d.get("peak_bytes_in_use")
+        measured = round(peak / 2 ** 30, 3) if peak else None
+        delta = round(measured - predicted_gb, 3) \
+            if (measured is not None and predicted_gb is not None) else None
+        rows.append({"device": d["device"],
+                     "memplan_predicted_gb":
+                         round(predicted_gb, 3)
+                         if predicted_gb is not None else None,
+                     "measured_peak_gb": measured,
+                     "delta": delta})
+    return rows
+
+
 def plan_memory(model_cfg: LLMConfig, train_cfg: TrainConfig, *,
                 n_devices: Optional[int] = None,
                 hbm_gb: Optional[float] = None,

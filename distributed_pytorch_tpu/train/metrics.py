@@ -237,6 +237,31 @@ def mfu(cfg: LLMConfig, tokens_per_step: int, seq_len: int,
     return achieved / (peak * n_chips)
 
 
+def hbm_watermark() -> list[dict]:
+    """Per-LOCAL-device memory watermark: one dict per device with
+    `peak_bytes_in_use` / `bytes_in_use` (None-valued where the backend
+    doesn't report memory_stats — CPU). The sampling half of the
+    ROADMAP's "validate train/memplan.py estimates against
+    peak_bytes_in_use" item: the train loop probes this at compile,
+    first step, and log boundaries, and memplan.watermark_report turns
+    it into the predicted-vs-measured delta."""
+    try:
+        devices = jax.local_devices()
+    except Exception:  # pragma: no cover — backend init failed
+        return []
+    out = []
+    for d in devices:
+        try:
+            st = d.memory_stats() or {}
+        except Exception:  # noqa: BLE001 — CPU backends raise/return None
+            st = {}
+        out.append({"device": f"{getattr(d, 'platform', '?')}"
+                              f":{getattr(d, 'id', '?')}",
+                    "peak_bytes_in_use": st.get("peak_bytes_in_use"),
+                    "bytes_in_use": st.get("bytes_in_use")})
+    return out
+
+
 def device_memory_gb() -> float | None:
     """Peak device-memory use in GiB on the first local device, or None
     when the backend doesn't report it (CPU). The TPU equivalent of the

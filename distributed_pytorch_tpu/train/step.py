@@ -15,6 +15,7 @@ grads) that the reference gets from DDP/ZeroRedundancyOptimizer/FSDP.
 
 from __future__ import annotations
 
+import os
 from typing import Any, Optional
 
 import jax
@@ -78,6 +79,19 @@ def make_train_step(model, tx: optax.GradientTransformation,
     """
     from distributed_pytorch_tpu.ops import collective_matmul as cm
     recipe = train_cfg.parallelism
+    # Anomaly guard (ISSUE 10): 'warn' adds a device-side nonfinite flag
+    # to the step metrics (drained with them at sync boundaries — zero
+    # extra host round-trips); 'skip' additionally withholds the
+    # optimizer/moe update for a poisoned (NaN/inf loss or grad-norm)
+    # step so training keeps going on the last good params. 'off'
+    # removes the metric entirely.
+    anomaly = getattr(train_cfg, "anomaly", "warn")
+    # Fault injection for the guard (same spirit as scripts/
+    # fault_inject.py on the serving side): TRAIN_POISON_IT=<k> makes
+    # iteration k's batch produce NaN loss AND NaN grads — exactly what
+    # a corrupt data shard does — so the skip/record/resume path is
+    # testable without waiting for a real bad batch.
+    poison_it = int(os.environ.get("TRAIN_POISON_IT", "-1"))
     overlap_mode = cm.resolve_mode(getattr(train_cfg, "overlap", "auto"))
     overlap_on = (overlap_mode == "on" and mesh is not None
                   and recipe in cm._ZERO3_RECIPES
@@ -157,6 +171,14 @@ def make_train_step(model, tx: optax.GradientTransformation,
                 (x, y, jnp.arange(accum)))
         grads = jax.tree_util.tree_map(lambda g: g / accum, g_acc)
 
+        if poison_it >= 0:
+            # fault injection (see make_train_step): NaN-bomb this
+            # iteration's loss and gradients, as a poisoned batch would
+            bomb = jnp.where(state.step == poison_it,
+                             jnp.float32(jnp.nan), jnp.float32(1.0))
+            losses = losses * bomb
+            grads = jax.tree_util.tree_map(lambda g: g * bomb, grads)
+
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
 
@@ -164,6 +186,25 @@ def make_train_step(model, tx: optax.GradientTransformation,
             "loss": losses.mean(),
             "grad_norm": optax.global_norm(grads),
         }
+        if anomaly != "off":
+            finite = (jnp.isfinite(metrics["loss"])
+                      & jnp.isfinite(metrics["grad_norm"]))
+            metrics["nonfinite"] = (~finite).astype(jnp.float32)
+        if anomaly == "skip":
+            # withhold the whole update (params, optimizer moments AND
+            # moe routing state) when the step is poisoned: jnp.where
+            # on a scalar predicate selects per-leaf, so NaN updates
+            # never touch the kept values. state.step still advances —
+            # the loop's data stream and LR schedule are it-keyed, and
+            # a skipped step must consume its batch, not replay it.
+            def _keep_old(new, old):
+                return jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(finite, n, o), new, old)
+
+            new_params = _keep_old(new_params, state.params)
+            new_opt = _keep_old(new_opt, state.opt_state)
+            new_moe = _keep_old(new_moe, state.moe_state)
+            metrics["update_skipped"] = metrics["nonfinite"]
         if model_cfg.moe:
             metrics["moe_dropped_frac"] = _dropped_frac(new_moe)
         new_state = TrainState(step=state.step + 1, params=new_params,
@@ -177,6 +218,10 @@ def make_train_step(model, tx: optax.GradientTransformation,
                                                    leading_accum=True))
     repl = NamedSharding(mesh, P())
     metrics_sh = {"loss": repl, "grad_norm": repl}
+    if anomaly != "off":
+        metrics_sh["nonfinite"] = repl
+    if anomaly == "skip":
+        metrics_sh["update_skipped"] = repl
     if model_cfg.moe:
         metrics_sh["moe_dropped_frac"] = repl
     return jax.jit(

@@ -201,8 +201,25 @@ def time_variant(batch: int, attn_impl: str, act_recomp: bool,
     peak = M.peak_flops_per_chip()
     mfu = flops / dt / (peak * n_dev) if peak else float("nan")
     hbm = M.device_memory_gb()
+    # memplan predicted-vs-measured (ISSUE 10): price this exact variant
+    # (batch/remat/recipe) and put the peak_bytes_in_use delta next to
+    # the MFU column — the ladder sweep IS the ROADMAP's "validate
+    # train/memplan.py against peak_bytes_in_use" instrument
+    from distributed_pytorch_tpu.train import memplan
+    try:
+        mesh_sizes = dict(zip(mesh.axis_names, mesh.devices.shape)) \
+            if mesh is not None else {}
+        predicted, _ = memplan.predicted_train_peak_gb(
+            model_cfg, train_cfg, mesh_sizes)
+        predicted = round(predicted, 3)
+    except Exception:  # noqa: BLE001 — the plan must never sink a variant
+        predicted = None
+    plan_delta = round(hbm - predicted, 3) \
+        if (hbm is not None and predicted is not None) else None
     tag = "" if (preset, recipe) == ("gpt2_124m", "single") \
         else f" [{preset}/{recipe}]"
+    if plan_delta is not None:
+        tag += f" [plan {predicted:.2f}GB Δ{plan_delta:+.2f}]"
     if moe_impl:
         # MFU counts active-expert FLOPs; the overcompute factor says how
         # much the dispatch overspends delivering them (dense E/k x,
@@ -214,10 +231,26 @@ def time_variant(batch: int, attn_impl: str, act_recomp: bool,
           f"{tokens / dt:9.0f} tok/s | mfu {mfu:6.2%} | "
           f"hbm {hbm or 0:5.2f}GB{tag}",
           flush=True)
-    return {"batch": batch, "attn": attn_impl, "remat": act_recomp,
-            "loss": loss_impl, "ms": dt * 1e3, "mfu": mfu,
-            "preset": preset, "recipe": recipe,
-            "moe_impl": moe_impl or None}
+    out = {"batch": batch, "attn": attn_impl, "remat": act_recomp,
+           "loss": loss_impl, "ms": dt * 1e3, "mfu": mfu,
+           "preset": preset, "recipe": recipe,
+           "moe_impl": moe_impl or None,
+           "memplan_predicted_gb": predicted, "measured_peak_gb": hbm,
+           "memplan_delta_gb": plan_delta}
+    # persist the variant as one train_timeline.jsonl record under
+    # runs/ (the round-14 artifact convention: every leg's JSON points
+    # at its on-disk timeline via "artifacts")
+    try:
+        from distributed_pytorch_tpu.obs.flight import FlightRecorder
+        leg = (f"mfu_sweep/{preset}_{recipe}_b{batch}_{attn_impl}"
+               f"_{'remat' if act_recomp else 'norem'}_{loss_impl}")
+        fl = FlightRecorder(capacity=8)
+        fl.record(**{k: v for k, v in out.items() if v is not None})
+        out["artifacts"] = {"train_timeline": fl.dump_jsonl(
+            os.path.join("runs", leg, "train_timeline.jsonl"))}
+    except Exception:  # noqa: BLE001 — artifacts never sink the variant
+        pass
+    return out
 
 
 def main():

@@ -17,11 +17,16 @@ and exits without compiling anything.
 """
 
 from distributed_pytorch_tpu.config import (PRESETS, build_parser,
-                                            configs_from_args)
+                                            configs_from_args, knobs_table)
 
 
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
+    if args.knobs:
+        # the registry is declared entirely in config.py — no jax import,
+        # so this works anywhere the package installs
+        print(knobs_table())
+        return
     model_defaults = None
     if args.preset:
         # re-parse against the preset's defaults so explicit flags win
@@ -39,10 +44,16 @@ def main(argv=None) -> None:
         jax.config.update("jax_platforms", train_cfg.platform)
 
     if args.dryrun:
+        from distributed_pytorch_tpu.parallel import shardcheck
         from distributed_pytorch_tpu.train.memplan import plan_memory
         plan = plan_memory(model_cfg, train_cfg,
                            preset_name=args.preset or "custom")
         print(plan.summary())
+        # the same device-free spec validation the CI static-analysis
+        # gate runs: a recipe/mesh mistake surfaces here, not on silicon
+        report = shardcheck.check_train_config(
+            model_cfg, train_cfg, preset=args.preset or "custom")
+        print(shardcheck.format_report(report))
         return
 
     from distributed_pytorch_tpu.train.loop import train

@@ -107,6 +107,8 @@ def request_cpu_devices(n: int) -> None:
     except (AttributeError, RuntimeError):
         pass
     flag = f"--xla_force_host_platform_device_count={n}"
-    flags = os.environ.get("XLA_FLAGS", "")
+    # read-modify-write of XLA's own env var BEFORE backend init — not a
+    # tunable of ours, so it stays outside the config.py knob registry
+    flags = os.environ.get("XLA_FLAGS", "")  # lint: allow(env-read)
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (flags + " " + flag).strip()

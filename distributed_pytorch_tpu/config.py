@@ -21,8 +21,144 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
-from dataclasses import dataclass
-from typing import Literal, Optional
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Literal, Optional
+
+# ---------------------------------------------------------------------------
+# Env-knob registry (ISSUE 12). Every tunable the package reads from the
+# process environment is declared HERE — name, default, parser, one-line
+# doc — and read through `knob()`. Modules never touch os.environ
+# directly (scripts/lint.py's env-read rule enforces this), so the full
+# tunable surface is one table: `python -m distributed_pytorch_tpu
+# --knobs` prints it, and a bench/sweep leg can grep it instead of the
+# source. Values are parsed PER READ (never cached here) so tests and
+# sweep subprocesses can monkeypatch the environment; modules that want
+# import-time freezing (kernel tile sizes) assign the result to a module
+# constant exactly as before.
+# ---------------------------------------------------------------------------
+
+def _onoff(s: str) -> str:
+    v = s.strip().lower()
+    if v not in ("auto", "on", "off"):
+        raise ValueError(f"expected auto|on|off, got {s!r}")
+    return v
+
+
+@dataclass(frozen=True)
+class Knob:
+    """One registered environment tunable."""
+
+    name: str
+    default: str                       # raw string, parsed like an env read
+    parse: Callable[[str], Any]
+    doc: str
+
+    def read(self) -> Any:
+        """Parsed value: the process env var when set, else the default."""
+        raw = os.environ.get(self.name)
+        if raw is None:
+            raw = self.default
+        return self.parse(raw)
+
+
+ENV_KNOBS: dict[str, Knob] = {}
+
+
+def register_knob(name: str, default: str, parse: Callable[[str], Any] = str,
+                  doc: str = "") -> Knob:
+    k = Knob(name, default, parse, doc)
+    ENV_KNOBS[name] = k
+    return k
+
+
+def knob(name: str) -> Any:
+    """Read one registered knob (KeyError on unregistered names — typos
+    fail loudly instead of silently defaulting)."""
+    return ENV_KNOBS[name].read()
+
+
+def knobs_table() -> str:
+    """Human-readable registry dump (the --knobs CLI payload): name,
+    default, current value (* when the env overrides), doc."""
+    rows = [("KNOB", "DEFAULT", "CURRENT", "DOC")]
+    for k in sorted(ENV_KNOBS.values(), key=lambda k: k.name):
+        cur = k.read()
+        mark = "*" if os.environ.get(k.name) is not None else ""
+        rows.append((k.name, k.default, f"{cur}{mark}", k.doc))
+    w0 = max(len(r[0]) for r in rows)
+    w1 = max(len(r[1]) for r in rows)
+    w2 = max(len(r[2]) for r in rows)
+    return "\n".join(f"{r[0]:<{w0}}  {r[1]:<{w1}}  {r[2]:<{w2}}  {r[3]}"
+                     for r in rows)
+
+
+# --- kernel tile sizes (read at import by their owner modules so
+# mfu_sweep can A/B them per subprocess) ---
+register_knob("FLASH_BLOCK_Q", "256", int,
+              "flash-attention query tile rows (ops/flash_attention.py)")
+register_knob("FLASH_BLOCK_K", "512", int,
+              "flash-attention kv tile length")
+register_knob("FLASH_BLOCK_H", "8", int,
+              "flash-attention rows per grid group")
+register_knob("FLASH_LAYOUT", "rows", lambda s: s.strip().lower(),
+              "flash kernel layout: rows (BTNH transpose) | slab")
+register_knob("FLASH_VMEM_BUDGET_MB", "64", int,
+              "VMEM budget gate for flash kernels (half of v5e core VMEM)")
+register_knob("CE_BLOCK_N", "512", int,
+              "pallas fused-CE token tile (ops/fused_ce.py)")
+register_knob("CE_BLOCK_V", "2048", int,
+              "pallas fused-CE vocab tile")
+register_knob("GMM_BLOCK_M", "128", int,
+              "grouped-matmul token-row tile (ops/grouped_matmul.py)")
+register_knob("GMM_BLOCK_N", "512", int,
+              "grouped-matmul out-feature tile")
+register_knob("GMM_BLOCK_K", "512", int,
+              "grouped-matmul contraction tile")
+register_knob("FLASH_DECODE_BLOCK", "512", int,
+              "flash-decode kv-length tile (ops/flash_decode.py)")
+
+# --- auto|on|off feature gates (read per call; tests monkeypatch env) ---
+register_knob("FLASH_DECODE", "auto", _onoff,
+              "split-KV flash decode kernel gate")
+register_knob("OVERLAP", "", lambda s: s.strip().lower(),
+              "collective-matmul overlap rings: on|off|auto; empty defers "
+              "to TrainConfig.overlap (ops/collective_matmul.py)")
+register_knob("OVERLAP_RING", "bidir", lambda s: s.strip().lower(),
+              "overlap ring direction: bidir | uni (A/B legs)")
+register_knob("QUANT_KV", "auto",
+              lambda s: _onoff(s) if s.strip() else "auto",
+              "int8 KV-cache gate (ops/quant.py)")
+register_knob("QUANT_W", "auto",
+              lambda s: _onoff(s) if s.strip() else "auto",
+              "int8 weight-matmul gate")
+
+# --- observability / fault injection ---
+register_knob("TRACE", "on",
+              lambda s: s.lower() not in ("off", "0", ""),
+              "request-trace recorder enable (obs/trace.py)")
+register_knob("TRACE_CAPACITY", "8192", int,
+              "span-ring capacity of the process-default TraceRecorder")
+register_knob("TRACE_GUARD", "warn", lambda s: s.strip().lower() or "warn",
+              "retrace-guard violation handling: warn | strict | off "
+              "(obs/retrace.py)")
+register_knob("TRAIN_POISON_IT", "-1", int,
+              "NaN-bomb iteration k's loss+grads (anomaly-guard fault "
+              "injection, train/step.py)")
+
+# --- multi-process topology announcements (train/loop.py reads these to
+# decide whether jax.distributed.initialize is required; empty = unset) ---
+register_knob("JAX_COORDINATOR_ADDRESS", "", str,
+              "explicit multi-process coordinator host:port")
+register_knob("JAX_NUM_PROCESSES", "", str,
+              "explicit multi-process world size")
+register_knob("JAX_PROCESS_ID", "", str,
+              "this host's process id in the explicit topology")
+register_knob("TPU_WORKER_HOSTNAMES", "", str,
+              "Cloud TPU pod metadata: comma-separated worker hosts")
+register_knob("MEGASCALE_COORDINATOR_ADDRESS", "", str,
+              "multislice (megascale) coordinator announcement")
+
 
 ACTIVATIONS = (
     "relu", "gelu", "swish", "mish", "silu", "selu", "celu", "elu",
@@ -380,8 +516,12 @@ def build_parser(model_defaults: LLMConfig | None = None,
                         "explicit flags still override its fields")
     p.add_argument("--dryrun", action="store_true", default=False,
                    help="print the static HBM plan (micro-batch, remat "
-                        "policy, est. peak HBM, grad-accum) and exit "
+                        "policy, est. peak HBM, grad-accum) and the "
+                        "shardcheck findings for the recipe, then exit "
                         "without training")
+    p.add_argument("--knobs", action="store_true", default=False,
+                   help="print the env-knob registry (name, default, "
+                        "current value, doc) and exit")
     return p
 
 

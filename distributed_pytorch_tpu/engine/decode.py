@@ -81,6 +81,7 @@ import numpy as np
 from distributed_pytorch_tpu.models.generate import sample_token
 from distributed_pytorch_tpu.models.gpt import init_paged_cache
 from distributed_pytorch_tpu.obs.flight import FlightRecorder
+from distributed_pytorch_tpu.obs.retrace import TraceGuard
 from distributed_pytorch_tpu.ops.block_pool import (BlockPool, NoFreeBlocks,
                                                     chain_keys)
 from distributed_pytorch_tpu.parallel import context
@@ -327,8 +328,16 @@ class DecodeEngine:
         self._step_fn = None
         self._fused_step_fn = None
         self._admit_fns: dict[int, Any] = {}
-        self.step_traces = 0                   # test hook: must stay 1
-        self.fused_step_traces = 0             # ditto for the chunked step
+        # retrace guards (obs/retrace.py): each compiled family budgets
+        # its legitimate trace count — step/fused_step trace ONCE for any
+        # serving mix, admit once per prompt bucket (budget raised at
+        # bucket creation). `step_traces`/`fused_step_traces` properties
+        # keep the historical int surface for tests and bench asserts.
+        self.trace_guards: dict[str, TraceGuard] = {
+            "step": TraceGuard("engine.step"),
+            "fused_step": TraceGuard("engine.fused_step"),
+            "admit": TraceGuard("engine.admit", budget=0),
+        }
         self.admit_traces: dict[int, int] = {}  # bucket -> trace count
         # lifetime counters — the stable occupancy/accounting surface a
         # scheduler reads instead of poking _slots
@@ -374,7 +383,7 @@ class DecodeEngine:
             return self._step_fn
 
         def step(variables, caches, tok, pos, live, bt, rng, t, qparams):
-            self.step_traces += 1  # python side effect: counts traces only
+            self.trace_guards["step"].mark()  # trace-time side effect
             from distributed_pytorch_tpu.ops.quant import use_quantized_params
             with use_quantized_params(qparams):
                 # quantized weights (when a store is active): decode
@@ -408,7 +417,7 @@ class DecodeEngine:
 
         def fused_step(variables, caches, tok, pos, live, bt, rng, t,
                        qparams, ctoks, cslot, coff, clen, cdone):
-            self.fused_step_traces += 1  # python side effect: trace count
+            self.trace_guards["fused_step"].mark()  # trace-time side effect
             # chunk prefill: write [coff, coff+N) of the chunk slot's
             # logical sequence (rows past clen are pads landing in the
             # null block via zero table entries) and attend causally over
@@ -453,6 +462,7 @@ class DecodeEngine:
 
         def admit(variables, caches, tok, pos, live, bt, prompt, prefix_len,
                   true_len, slot, rng):
+            self.trace_guards["admit"].mark()
             self.admit_traces[bucket] = self.admit_traces.get(bucket, 0) + 1
             # suffix prefill straight into the slot's pool blocks: the
             # reused prefix is already resident, so the forward starts at
@@ -470,6 +480,9 @@ class DecodeEngine:
             live = live.at[slot].set(True)
             return caches, tok, pos, live, first
 
+        # a fresh bucket legitimately compiles one new program; a RE-trace
+        # of an existing bucket stays over budget and trips the guard
+        self.trace_guards["admit"].allow()
         fn = jax.jit(admit, donate_argnums=self._donate)
         self._admit_fns[bucket] = fn
         return fn
@@ -477,6 +490,14 @@ class DecodeEngine:
     # ------------------------------------------------------------------
     # host API
     # ------------------------------------------------------------------
+
+    @property
+    def step_traces(self) -> int:
+        return self.trace_guards["step"].count
+
+    @property
+    def fused_step_traces(self) -> int:
+        return self.trace_guards["fused_step"].count
 
     @property
     def free_slots(self) -> list[int]:
@@ -684,7 +705,9 @@ class DecodeEngine:
                 jnp.asarray([len(suffix)], jnp.int32),
                 jnp.int32(slot), rng)
         self.caches, self.tok, self.pos, self.live, first = out
-        first_tok = int(jax.device_get(first)[0])
+        # THE admit sync boundary: the first sampled token must reach the
+        # host to stream it to the caller
+        first_tok = int(jax.device_get(first)[0])  # lint: allow(host-sync)
         self._slots[slot] = _Slot(seq_id=seq_id, tokens=toks + [first_tok],
                                   prompt_len=L, n_new=1,
                                   max_new=max_new_tokens, pos=L,
@@ -872,7 +895,9 @@ class DecodeEngine:
                     self.live, self.block_tables, self._rng,
                     jnp.int32(self._t), self._qparams)
         self._t += 1
-        sampled = jax.device_get(self.tok)
+        # THE step sync boundary: every slot's sampled token drains to the
+        # host once per fused step
+        sampled = jax.device_get(self.tok)  # lint: allow(host-sync)
         emitted: dict[int, int] = {}
         retired: dict[int, Retired] = dict(preempted)
         prefill_tokens = 0

@@ -27,8 +27,10 @@ anomaly monitor, and an opt-in live HTTP endpoint — dumped to
 """
 
 from distributed_pytorch_tpu.obs.flight import FlightRecorder
+from distributed_pytorch_tpu.obs.retrace import (RetraceError, TraceGuard,
+                                                 guarded)
 from distributed_pytorch_tpu.obs.trace import (TraceRecorder, get_recorder,
                                                new_trace_id, set_recorder)
 
-__all__ = ["FlightRecorder", "TraceRecorder", "get_recorder",
-           "new_trace_id", "set_recorder"]
+__all__ = ["FlightRecorder", "RetraceError", "TraceGuard", "TraceRecorder",
+           "get_recorder", "guarded", "new_trace_id", "set_recorder"]

@@ -38,13 +38,20 @@ class FlightRecorder:
         self.total = 0             # records ever written
         self._ring: collections.deque = collections.deque(maxlen=capacity)
         self._lock = threading.Lock()
+        # one wall-clock read at construction anchors the timeline to
+        # absolute time (so `t` still correlates with server logs and
+        # Prometheus scrapes); per-record stamps advance MONOTONICALLY
+        # from it, so an NTP slew mid-run can never make step timestamps
+        # jump backwards or overlap
+        self._wall0 = time.time()  # lint: allow(wall-clock)
+        self._mono0 = time.monotonic()
 
     def record(self, **fields) -> None:
-        """Append one step record (stamped with wall-clock `t` so the
-        timeline correlates with server logs and Prometheus scrapes)."""
+        """Append one step record, stamped with `t` = the construction
+        wall-clock anchor plus a monotonic delta."""
         if not self.enabled:
             return
-        fields["t"] = round(time.time(), 4)
+        fields["t"] = round(self._wall0 + (time.monotonic() - self._mono0), 4)
         with self._lock:
             if len(self._ring) == self.capacity:
                 self.dropped += 1

@@ -1,0 +1,112 @@
+"""Reusable retrace guards (ISSUE 12).
+
+The repo's compiled hot paths are deliberately shaped so ONE trace serves
+the whole workload: the engine's fused step traces once for any serving
+mix (chunk offsets/lengths are traced values), the wave admit traces once
+per prompt bucket, and the train step traces once per run. Recompiles are
+the classic silent TPU performance cliff — a shape or dtype leak turns a
+one-trace program into a per-call retrace and the step time graph goes
+sawtooth with no error anywhere.
+
+Previously the invariant lived in ad-hoc test assertions
+(`eng.fused_step_traces == 1`). `TraceGuard` makes it a runtime object:
+the traced fn body calls `mark()` as a Python side effect (it runs at
+TRACE time, never per execution), the guard counts traces against a
+budget, and a violation is handled per the TRACE_GUARD knob —
+
+* ``warn`` (default): log once per excess trace, keep counting; the
+  excess is exported on /metrics so dashboards catch the cliff;
+* ``strict``: raise `RetraceError` at the offending trace (test/CI mode);
+* ``off``: count only.
+
+`expect()` bounds a region instead of the lifetime: the train loop wraps
+each step call with `expect(0)` after the first so a mid-run recompile is
+caught at the iteration that caused it.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+
+from distributed_pytorch_tpu import config
+
+log = logging.getLogger("retrace")
+
+
+class RetraceError(RuntimeError):
+    """A guarded function re-traced past its budget (TRACE_GUARD=strict)."""
+
+
+class TraceGuard:
+    """Counts jit traces of one compiled-function family against a budget.
+
+    Place `guard.mark()` as the first line of the traced fn body; jit runs
+    Python once per trace, so the count is exactly the number of compiled
+    programs built for that family.
+    """
+
+    def __init__(self, name: str, budget: int = 1):
+        self.name = name
+        self.budget = budget
+        self.count = 0
+        self._lock = threading.Lock()
+
+    @property
+    def excess(self) -> int:
+        return max(0, self.count - self.budget)
+
+    def allow(self, n: int = 1) -> None:
+        """Raise the budget by `n` — call when a NEW program is legitimate
+        (e.g. the engine admit path compiling a fresh prompt bucket)."""
+        with self._lock:
+            self.budget += n
+
+    def mark(self) -> None:
+        with self._lock:
+            self.count += 1
+            count, budget = self.count, self.budget
+        if count > budget:
+            self._violate(
+                f"{self.name}: trace #{count} exceeds budget {budget}")
+
+    @contextlib.contextmanager
+    def expect(self, max_new: int = 0):
+        """Assert at most `max_new` fresh traces occur inside the block."""
+        before = self.count
+        yield self
+        new = self.count - before
+        if new > max_new:
+            self._violate(f"{self.name}: {new} new trace(s) in a region "
+                          f"expecting <= {max_new}")
+
+    def stats(self) -> dict:
+        return {"count": self.count, "budget": self.budget,
+                "excess": self.excess}
+
+    def _violate(self, msg: str) -> None:
+        mode = config.knob("TRACE_GUARD")
+        if mode == "strict":
+            raise RetraceError(msg)
+        if mode != "off":
+            log.warning("[retrace] %s", msg)
+
+
+class GuardedFn:
+    """Pairs a jitted callable with its TraceGuard (jit function objects
+    reject attribute assignment). Delegates everything else to the fn."""
+
+    def __init__(self, fn, guard: TraceGuard):
+        self._fn = fn
+        self.trace_guard = guard
+
+    def __call__(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._fn, name)
+
+
+def guarded(fn, guard: TraceGuard) -> GuardedFn:
+    return GuardedFn(fn, guard)

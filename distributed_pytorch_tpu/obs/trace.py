@@ -242,9 +242,11 @@ class TraceRecorder:
 # request's router/scheduler/server spans land in the same place)
 # ----------------------------------------------------------------------
 
+from distributed_pytorch_tpu import config as _config
+
 _default = TraceRecorder(
-    capacity=int(os.environ.get("TRACE_CAPACITY", "8192")),
-    enabled=os.environ.get("TRACE", "on").lower() not in ("off", "0", ""))
+    capacity=_config.knob("TRACE_CAPACITY"),
+    enabled=_config.knob("TRACE"))
 
 
 def get_recorder() -> TraceRecorder:

@@ -45,14 +45,13 @@ contraction dim disqualifies (row-parallel matmuls keep the GSPMD path).
 from __future__ import annotations
 
 import functools
-import os
 from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from distributed_pytorch_tpu import compat
+from distributed_pytorch_tpu import compat, config
 from distributed_pytorch_tpu.parallel import context
 from distributed_pytorch_tpu.parallel.sharding import spec_for_param
 
@@ -71,7 +70,7 @@ def resolve_mode(config_mode: str = "auto") -> str:
 
     The OVERLAP env var (on/off/auto) wins over the TrainConfig field so
     bench/sweep legs can A/B without a config plumb-through."""
-    mode = os.environ.get("OVERLAP", "").strip().lower() or config_mode
+    mode = config.knob("OVERLAP") or config_mode
     if mode not in ("auto", "on", "off"):
         raise ValueError(f"OVERLAP must be auto|on|off, got {mode!r}")
     return _AUTO_RESOLVES_TO if mode == "auto" else mode
@@ -80,7 +79,7 @@ def resolve_mode(config_mode: str = "auto") -> str:
 def _ring_style() -> bool:
     """True = bidirectional (both ICI directions, ~half the sequential
     hops); env OVERLAP_RING=uni forces the one-way ring for A/B."""
-    return os.environ.get("OVERLAP_RING", "bidir").strip().lower() != "uni"
+    return config.knob("OVERLAP_RING") != "uni"
 
 
 # ---------------------------------------------------------------------------

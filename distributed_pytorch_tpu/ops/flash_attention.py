@@ -46,20 +46,21 @@ The public entry points keep the interface the dispatcher
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from distributed_pytorch_tpu import config
+
 # Tile-size knobs (read at import so scripts/mfu_sweep.py --variants blocks
 # can A/B them per subprocess without an API change). 256x512 q/kv tiles and
 # an 8-row group are the provisional v5e winners pending the on-hardware
 # block sweep (PERF.md round 4).
-DEFAULT_BLOCK_Q = int(os.environ.get("FLASH_BLOCK_Q", "256"))
-DEFAULT_BLOCK_K = int(os.environ.get("FLASH_BLOCK_K", "512"))
-DEFAULT_BLOCK_H = int(os.environ.get("FLASH_BLOCK_H", "8"))
+DEFAULT_BLOCK_Q = config.knob("FLASH_BLOCK_Q")
+DEFAULT_BLOCK_K = config.knob("FLASH_BLOCK_K")
+DEFAULT_BLOCK_H = config.knob("FLASH_BLOCK_H")
 
 # Kernel layout (round 5): 'rows' flattens (B, H) into grid rows and needs
 # a BTNH -> (B*H, T, D) HBM transpose per operand per call — the profile's
@@ -70,7 +71,7 @@ DEFAULT_BLOCK_H = int(os.environ.get("FLASH_BLOCK_H", "8"))
 # Default stays 'rows' — the only layout that has compiled on real TPU
 # hardware so far — until the on-hardware sweep (mfu_sweep --variants
 # blocks, FLASH_LAYOUT legs) proves the slab path.
-DEFAULT_LAYOUT = os.environ.get("FLASH_LAYOUT", "rows")
+DEFAULT_LAYOUT = config.knob("FLASH_LAYOUT")
 
 _NEG_INF = -1e30  # large-negative instead of -inf: keeps masked rows NaN-free
 
@@ -206,7 +207,7 @@ def _kv_spec(rep: int, g: int, block_q: int, block_k: int, D: int,
 # double-buffering slack so an oversized block/group config degrades (smaller
 # row group, or XLA fallback via the usable gate) instead of hard-failing
 # compilation with a Mosaic VMEM-exceeded error (round-4 ADVICE).
-_VMEM_BUDGET = int(os.environ.get("FLASH_VMEM_BUDGET_MB", "64")) * 2 ** 20
+_VMEM_BUDGET = config.knob("FLASH_VMEM_BUDGET_MB") * 2 ** 20
 
 
 def _vmem_bytes(g: int, gk: int, bq: int, bk: int, D: int,

@@ -52,29 +52,29 @@ mode off-TPU — the CPU parity tests), 'off' pins the naive path.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from distributed_pytorch_tpu import config
 from distributed_pytorch_tpu.compat import tpu_compiler_params
 
 # KV-length tile (lane dimension of the score tiles). Env knob so
 # `mfu_sweep --variants decode` can ablate it per subprocess, like
 # FLASH_BLOCK_* / GMM_BLOCK_*.
-DEFAULT_BLOCK_S = int(os.environ.get("FLASH_DECODE_BLOCK", "512"))
+DEFAULT_BLOCK_S = config.knob("FLASH_DECODE_BLOCK")
 
 _NEG_INF = -1e30  # large-negative instead of -inf: keeps masked rows NaN-free
 
 # one grid step's buffers: double-buffered kv tiles + f32 scratch + scores
-_VMEM_BUDGET = int(os.environ.get("FLASH_VMEM_BUDGET_MB", "64")) * 2 ** 20
+_VMEM_BUDGET = config.knob("FLASH_VMEM_BUDGET_MB") * 2 ** 20
 
 
 def decode_mode() -> str:
     """'auto' | 'on' | 'off' — read per call (tests monkeypatch env)."""
-    return os.environ.get("FLASH_DECODE", "auto")
+    return config.knob("FLASH_DECODE")
 
 
 def _pick_block(n: int, preferred: int, step: int) -> int:

@@ -37,7 +37,6 @@ model==1 and seq==1 (gpt.py does) and fall back to the chunked path.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
@@ -45,12 +44,13 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import PartitionSpec as P
 
-DEFAULT_BLOCK_N = int(os.environ.get("CE_BLOCK_N", "512"))     # tokens
-DEFAULT_BLOCK_V = int(os.environ.get("CE_BLOCK_V", "2048"))    # vocab
+from distributed_pytorch_tpu import config
+from distributed_pytorch_tpu.compat import tpu_compiler_params
+
+DEFAULT_BLOCK_N = config.knob("CE_BLOCK_N")     # tokens
+DEFAULT_BLOCK_V = config.knob("CE_BLOCK_V")     # vocab
 
 _NEG_INF = -1e30
-
-from distributed_pytorch_tpu.compat import tpu_compiler_params
 
 _SEMANTICS = tpu_compiler_params(
     dimension_semantics=("parallel", "arbitrary"))

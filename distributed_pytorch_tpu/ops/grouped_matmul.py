@@ -56,7 +56,6 @@ and one combine scatter-add.
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional
 
 import jax
@@ -64,12 +63,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from distributed_pytorch_tpu import compat
+from distributed_pytorch_tpu import compat, config
 from distributed_pytorch_tpu.parallel import context
 
-DEFAULT_BLOCK_M = int(os.environ.get("GMM_BLOCK_M", "128"))   # token rows
-DEFAULT_BLOCK_N = int(os.environ.get("GMM_BLOCK_N", "512"))   # out features
-DEFAULT_BLOCK_K = int(os.environ.get("GMM_BLOCK_K", "512"))   # contraction
+DEFAULT_BLOCK_M = config.knob("GMM_BLOCK_M")   # token rows
+DEFAULT_BLOCK_N = config.knob("GMM_BLOCK_N")   # out features
+DEFAULT_BLOCK_K = config.knob("GMM_BLOCK_K")   # contraction
 
 
 def _pick(n: int, preferred: int, step: int) -> int:

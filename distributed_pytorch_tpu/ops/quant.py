@@ -46,11 +46,12 @@ engine falls back to bf16 instead of crashing, like
 from __future__ import annotations
 
 import contextlib
-import os
 from typing import Any, Mapping, Optional
 
 import jax
 import jax.numpy as jnp
+
+from distributed_pytorch_tpu import config
 
 # int8 symmetric range: +-127 (the -128 code is unused so the grid is
 # symmetric and dequant is a pure scale multiply)
@@ -59,11 +60,11 @@ _Q_MAX = 127.0
 
 def kv_quant_mode() -> str:
     """'auto' | 'on' | 'off' — read per call (tests monkeypatch env)."""
-    return os.environ.get("QUANT_KV", "auto").strip().lower() or "auto"
+    return config.knob("QUANT_KV")
 
 
 def weight_quant_mode() -> str:
-    return os.environ.get("QUANT_W", "auto").strip().lower() or "auto"
+    return config.knob("QUANT_W")
 
 
 def resolve_gate(mode: str, requested: bool) -> bool:

@@ -55,8 +55,14 @@ _TP_RULES: tuple[tuple[tuple[str, ...], int], ...] = (
     (("tkn_emb", "embedding"), 0),
     (("c_attn", "kernel"), 1),
     (("c_attn", "bias"), 0),
-    (("c_proj", "kernel"), 0),       # attention out-proj AND mlp down-proj
+    (("c_proj", "kernel"), 0),       # attention out-proj (_OverlapDense)
     (("c_fc",), 1),                  # mlp up-proj (param, no /kernel suffix)
+    # mlp down-proj is a BARE param named c_proj (models/mlp.py:162), so
+    # the ("c_proj", "kernel") suffix above never matched it — found by
+    # parallel/shardcheck.py (replicated-large: 1.3%/layer of the 124M
+    # params silently replicated under tp). Row-parallel input dim, like
+    # its attention namesake.
+    (("c_proj",), 0),
     (("W_uq",), 1),                  # MLA: per-head dims are outputs
     (("W_uk",), 1),
     (("W_uv",), 1),

@@ -258,6 +258,17 @@ class Scheduler:
         self.metrics.register_gauge(
             "serve_prefix_hit_rate", lambda: self.engine.prefix_hit_rate,
             "lifetime fraction of prompt tokens served from cached blocks")
+        # retrace guards (obs/retrace.py): total compiled traces and the
+        # over-budget excess per program family — excess > 0 means the
+        # one-trace serving invariant broke (the silent recompile cliff)
+        self.metrics.register_gauge(
+            "serve_engine_traces_total",
+            lambda: sum(g.count for g in self.engine.trace_guards.values()),
+            "compiled engine program traces across step/fused_step/admit")
+        self.metrics.register_gauge(
+            "serve_engine_retrace_excess",
+            lambda: sum(g.excess for g in self.engine.trace_guards.values()),
+            "engine traces past budget — should be 0")
         # provenance: the engine's serving-relevant config as a
         # Prometheus info gauge (and in the bench JSON via summary())
         self.metrics.set_build_info(**engine_build_info(engine))

@@ -34,6 +34,7 @@ from typing import Any, Callable, Optional
 import jax
 import numpy as np
 
+from distributed_pytorch_tpu import config as cfg_mod
 from distributed_pytorch_tpu.config import LLMConfig, TrainConfig
 from distributed_pytorch_tpu.data.loader import DataLoader, make_synthetic_bin
 from distributed_pytorch_tpu.models.gpt import count_params
@@ -60,7 +61,14 @@ def multihost_env_detected(environ=None) -> bool:
       (comma-separated; >1 entry means a pod slice spanning hosts);
     * multislice (megascale) coordinator: MEGASCALE_COORDINATOR_ADDRESS.
     """
-    env = environ if environ is not None else os.environ
+    if environ is None:
+        # Route through the knob registry (config.ENV_KNOBS) so the
+        # topology variables show up in `--knobs`; tests still inject a
+        # plain dict via `environ`.
+        environ = {k: cfg_mod.knob(k) for k in (
+            "JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+            "TPU_WORKER_HOSTNAMES", "MEGASCALE_COORDINATOR_ADDRESS")}
+    env = environ
     if env.get("JAX_COORDINATOR_ADDRESS"):
         return True
     nproc = env.get("JAX_NUM_PROCESSES")
@@ -111,13 +119,13 @@ def maybe_initialize_distributed() -> None:
     # torchrun path likewise rendezvouses or dies (ddp/train.py:19-25).
     try:
         kwargs = {}
-        if os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        if cfg_mod.knob("JAX_COORDINATOR_ADDRESS"):
             kwargs["coordinator_address"] = \
-                os.environ["JAX_COORDINATOR_ADDRESS"]
-        if os.environ.get("JAX_NUM_PROCESSES"):
-            kwargs["num_processes"] = int(os.environ["JAX_NUM_PROCESSES"])
-        if os.environ.get("JAX_PROCESS_ID"):
-            kwargs["process_id"] = int(os.environ["JAX_PROCESS_ID"])
+                cfg_mod.knob("JAX_COORDINATOR_ADDRESS")
+        if cfg_mod.knob("JAX_NUM_PROCESSES"):
+            kwargs["num_processes"] = int(cfg_mod.knob("JAX_NUM_PROCESSES"))
+        if cfg_mod.knob("JAX_PROCESS_ID"):
+            kwargs["process_id"] = int(cfg_mod.knob("JAX_PROCESS_ID"))
         jax.distributed.initialize(**kwargs)
     except Exception as e:
         raise RuntimeError(
@@ -381,6 +389,21 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
             memplan.predicted_train_peak_gb(model_cfg, train_cfg, sizes)
     except Exception as e:  # noqa: BLE001 — planning never stops a run
         memplan_pred_gb, memplan_breakdown = None, {"error": repr(e)}
+    # device-free spec-table validation (parallel/shardcheck.py): surface
+    # sharding mistakes — replicated-large, dead axes — at startup, where
+    # they cost a log line instead of an OOM'd or silently slow run.
+    # Advisory like memplan: findings never stop a run. Skipped for
+    # 'single' (nothing is sharded, and the eval_shape pass would tax
+    # every tiny unsharded test run for no findings).
+    if train_cfg.parallelism != "single":
+        try:
+            from distributed_pytorch_tpu.parallel import shardcheck
+            sc = shardcheck.check_train_config(model_cfg, train_cfg)
+            if sc.findings and is_main:
+                say(shardcheck.format_report(sc))
+        except Exception as e:  # noqa: BLE001
+            if is_main:
+                say(f"shardcheck skipped: {e!r}")
     # an anomaly event's data-shard coordinates: the loader is
     # step-keyed, so these + batch_step reproduce the poisoned batch
     data_coords = {"dataset": train_cfg.dataset, "seed": train_cfg.seed,
@@ -426,6 +449,19 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
     # instead of serializing with it. The reference syncs every step
     # (torch.cuda.synchronize, single-gpu/train.py:355) — an intentional
     # divergence. Per-step dt is the boundary window's average.
+    # retrace guard (obs/retrace.py): the first call may trace, every
+    # later iteration must reuse the compiled step — expect(0) pins a
+    # mid-run recompile to the iteration that caused it, and the guard's
+    # count/excess are exported as train_retraces gauges below.
+    step_guard = getattr(train_step, "trace_guard", None)
+    if step_guard is not None and tel.enabled:
+        tel.metrics.register_gauge(
+            "train_step_traces_total", lambda: float(step_guard.count),
+            "compiled train-step traces (budget 1; more = recompile cliff)")
+        tel.metrics.register_gauge(
+            "train_step_retrace_excess", lambda: float(step_guard.excess),
+            "train-step traces past budget — should be 0")
+
     x, y = train_loader.next_batch(step=start_step)
     pending: list = []                         # metric futures since last sync
     win_t0 = time.perf_counter()
@@ -473,7 +509,11 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
                     f"({time.perf_counter() - t0:.1f}s)")
                 win_t0 = time.perf_counter()       # eval time isn't step time
 
-            state, m = train_step(state, x, y)
+            if step_guard is not None:
+                with step_guard.expect(0 if step_guard.count else 1):
+                    state, m = train_step(state, x, y)
+            else:
+                state, m = train_step(state, x, y)
             pending.append(m)
             if it < train_cfg.max_iters:  # no wasted sample on the final iter
                 if tel.enabled:            # data_ms: the host-side fetch cost

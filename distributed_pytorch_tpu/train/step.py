@@ -15,7 +15,6 @@ grads) that the reference gets from DDP/ZeroRedundancyOptimizer/FSDP.
 
 from __future__ import annotations
 
-import os
 from typing import Any, Optional
 
 import jax
@@ -23,7 +22,9 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from distributed_pytorch_tpu import config as cfg_mod
 from distributed_pytorch_tpu.config import LLMConfig, TrainConfig
+from distributed_pytorch_tpu.obs.retrace import TraceGuard, guarded
 from distributed_pytorch_tpu.parallel import context, sharding as shd
 from distributed_pytorch_tpu.train.state import TrainState
 
@@ -91,7 +92,7 @@ def make_train_step(model, tx: optax.GradientTransformation,
     # iteration k's batch produce NaN loss AND NaN grads — exactly what
     # a corrupt data shard does — so the skip/record/resume path is
     # testable without waiting for a real bad batch.
-    poison_it = int(os.environ.get("TRAIN_POISON_IT", "-1"))
+    poison_it = cfg_mod.knob("TRAIN_POISON_IT")
     overlap_mode = cm.resolve_mode(getattr(train_cfg, "overlap", "auto"))
     overlap_on = (overlap_mode == "on" and mesh is not None
                   and recipe in cm._ZERO3_RECIPES
@@ -113,7 +114,14 @@ def make_train_step(model, tx: optax.GradientTransformation,
             new_moe = moe_state
         return loss, new_moe
 
+    # one trace serves the whole run: batch shapes are fixed by the config
+    # and state.step is a traced value. A mid-run retrace means a shape or
+    # weak-type leak — the guard counts it (and the loop's expect(0)
+    # window pins the offending iteration); see obs/retrace.py.
+    guard = TraceGuard("train.step")
+
     def train_step(state: TrainState, x: jnp.ndarray, y: jnp.ndarray):
+        guard.mark()  # trace-time side effect
         # publish the mesh (+ overlap mode) for the duration of TRACING:
         # sequence-parallel attention (ops/ring_attention.py) reads the
         # mesh to shard_map over 'seq'; the collective-matmul dispatcher
@@ -212,7 +220,7 @@ def make_train_step(model, tx: optax.GradientTransformation,
         return new_state, metrics
 
     if mesh is None:
-        return jax.jit(train_step, donate_argnums=(0,))
+        return guarded(jax.jit(train_step, donate_argnums=(0,)), guard)
 
     batch_sh = NamedSharding(mesh, shd.batch_pspec(recipe, mesh,
                                                    leading_accum=True))
@@ -224,12 +232,12 @@ def make_train_step(model, tx: optax.GradientTransformation,
         metrics_sh["update_skipped"] = repl
     if model_cfg.moe:
         metrics_sh["moe_dropped_frac"] = repl
-    return jax.jit(
+    return guarded(jax.jit(
         train_step,
         in_shardings=(state_sharding, batch_sh, batch_sh),
         out_shardings=(state_sharding, metrics_sh),
         donate_argnums=(0,),
-    )
+    ), guard)
 
 
 def make_eval_step(model, train_cfg: TrainConfig,

@@ -1,0 +1,247 @@
+#!/usr/bin/env python
+"""AST lint for repo invariants (ISSUE 12) — the failure modes that type
+checkers and pyflakes can't see, each of which has bitten a round of this
+repo:
+
+* ``host-sync``   — `jax.device_get(...)`, `.item()`, `float(jnp...)` /
+  `int(jax...)`, and `np.asarray(...)` on the traced hot-path modules
+  (train/step.py, engine/decode.py, models/, ops/). Each forces a
+  device->host round trip that serializes the async dispatch pipeline the
+  train loop and engine are built around. The deliberate sync boundaries
+  (the engine's wave-admit first-token read and step-end token drain)
+  carry a `# lint: allow(host-sync)` tag.
+* ``wall-clock``  — `time.time()` inside obs/: timelines and span rings
+  must be monotonic (an NTP slew mid-run makes wall-clock step durations
+  negative). One allowed wall read anchors obs/flight.py's timeline.
+* ``env-read``    — `os.environ` reads outside the knob registry
+  (config.py ENV_KNOBS): every tunable must be registered so
+  `python -m distributed_pytorch_tpu --knobs` shows the full surface and
+  typos fail loudly (config.knob raises on unregistered names).
+* ``pallas-gate`` — a module that issues `pallas_call` must define a
+  `*_usable` capability gate: every kernel needs a declared fallback
+  predicate or it crashes on CPU/older TPUs instead of falling back.
+
+Scoping: walking the package applies each rule only where it means
+something (see _rules_for). Explicitly listed files get EVERY rule —
+that is how the fixture tests (tests/lint_fixtures/) prove each rule
+fires. Suppress a deliberate violation with a trailing
+`# lint: allow(<rule>)` comment on the offending line.
+
+Usage::
+
+    python scripts/lint.py                 # lint the package, exit 0/1
+    python scripts/lint.py path.py ...     # lint files with ALL rules
+    python scripts/lint.py --json          # machine-readable findings
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import json
+import os
+import re
+import sys
+from typing import Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "distributed_pytorch_tpu")
+
+RULES = ("host-sync", "wall-clock", "env-read", "pallas-gate")
+
+# modules whose bodies run (mostly) under jit tracing — the host-sync scope
+_HOT_PATHS = ("train/step.py", "engine/decode.py", "models/", "ops/")
+_ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([^)]*)\)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    file: str
+    line: int
+    detail: str
+
+    def __str__(self) -> str:
+        return f"{self.file}:{self.line}: [{self.rule}] {self.detail}"
+
+
+def _rules_for(rel: str) -> set[str]:
+    rules: set[str] = set()
+    if any(rel == p or (p.endswith("/") and rel.startswith(p))
+           for p in _HOT_PATHS):
+        rules.add("host-sync")
+    if rel.startswith("obs/"):
+        rules.add("wall-clock")
+    if rel != "config.py":
+        rules.add("env-read")
+    rules.add("pallas-gate")
+    return rules
+
+
+def _attr_chain(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for nested Attribute/Name chains, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _Visitor(ast.NodeVisitor):
+    def __init__(self, rel: str, rules: set[str], src_lines: list[str]):
+        self.rel = rel
+        self.rules = rules
+        self.lines = src_lines
+        self.findings: list[Finding] = []
+        self.has_pallas: Optional[int] = None   # first pallas_call line
+        self.has_usable_gate = False
+
+    def _allowed(self, node: ast.AST, rule: str) -> bool:
+        line = self.lines[node.lineno - 1] if \
+            node.lineno <= len(self.lines) else ""
+        m = _ALLOW_RE.search(line)
+        return bool(m and rule in
+                    [r.strip() for r in m.group(1).split(",")])
+
+    def _flag(self, node: ast.AST, rule: str, detail: str) -> None:
+        if rule in self.rules and not self._allowed(node, rule):
+            self.findings.append(Finding(rule, self.rel, node.lineno,
+                                         detail))
+
+    # -- defs: pallas-gate bookkeeping ---------------------------------
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        if node.name.endswith("_usable"):
+            self.has_usable_gate = True
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for t in node.targets:
+            if isinstance(t, ast.Name) and t.id.endswith("_usable"):
+                self.has_usable_gate = True
+        self.generic_visit(node)
+
+    # -- calls ---------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        chain = _attr_chain(node.func)
+
+        if chain and chain.endswith("pallas_call") and \
+                self.has_pallas is None:
+            self.has_pallas = node.lineno
+
+        if chain in ("jax.device_get", "np.asarray", "numpy.asarray"):
+            self._flag(node, "host-sync",
+                       f"{chain}() forces a device->host sync on a "
+                       f"traced hot path")
+        elif isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "item" and not node.args and \
+                not node.keywords:
+            self._flag(node, "host-sync",
+                       ".item() forces a device->host sync on a traced "
+                       "hot path")
+        elif isinstance(node.func, ast.Name) and \
+                node.func.id in ("float", "int") and len(node.args) == 1:
+            arg = node.args[0]
+            inner = _attr_chain(arg.func) if isinstance(arg, ast.Call) \
+                else None
+            if inner and inner.split(".")[0] in ("jax", "jnp"):
+                self._flag(node, "host-sync",
+                           f"{node.func.id}({inner}(...)) blocks on a "
+                           f"device value")
+
+        if chain == "time.time":
+            self._flag(node, "wall-clock",
+                       "time.time() in obs/ — use time.monotonic()/"
+                       "perf_counter() (one anchored wall read allowed "
+                       "with a lint tag)")
+
+        if chain in ("os.environ.get", "os.getenv"):
+            self._flag(node, "env-read",
+                       f"{chain}() bypasses the knob registry — "
+                       f"register in config.py and use config.knob()")
+        self.generic_visit(node)
+
+    # -- subscripts: os.environ["X"] reads -----------------------------
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if isinstance(node.ctx, ast.Load) and \
+                _attr_chain(node.value) == "os.environ":
+            self._flag(node, "env-read",
+                       "os.environ[...] read bypasses the knob registry "
+                       "— register in config.py and use config.knob()")
+        self.generic_visit(node)
+
+
+def lint_file(path: str, rules: Optional[set[str]] = None,
+              rel: Optional[str] = None) -> list[Finding]:
+    rel = rel if rel is not None else os.path.relpath(path, PKG)
+    rules = rules if rules is not None else _rules_for(rel)
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding("syntax", rel, e.lineno or 0, str(e))]
+    v = _Visitor(rel, rules, src.splitlines())
+    v.visit(tree)
+    if "pallas-gate" in rules and v.has_pallas is not None and \
+            not v.has_usable_gate:
+        line = v.has_pallas
+        src_line = v.lines[line - 1] if line <= len(v.lines) else ""
+        if not (_ALLOW_RE.search(src_line) and
+                "pallas-gate" in _ALLOW_RE.search(src_line).group(1)):
+            v.findings.append(Finding(
+                "pallas-gate", rel, line,
+                "module issues pallas_call but defines no *_usable "
+                "capability gate (kernels need a declared fallback "
+                "predicate)"))
+    return v.findings
+
+
+def lint_package(root: str = PKG) -> list[Finding]:
+    findings: list[Finding] = []
+    for dirpath, _, files in sorted(os.walk(root)):
+        for name in sorted(files):
+            if not name.endswith(".py"):
+                continue
+            findings += lint_file(os.path.join(dirpath, name))
+    return findings
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python scripts/lint.py",
+        description="AST lint for repo invariants (host-sync, wall-clock,"
+                    " env-read, pallas-gate)")
+    ap.add_argument("files", nargs="*",
+                    help="lint these files with EVERY rule; default: "
+                    "walk distributed_pytorch_tpu/ with scoped rules")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings on stdout")
+    args = ap.parse_args(argv)
+
+    if args.files:
+        findings = []
+        for f in args.files:
+            findings += lint_file(f, rules=set(RULES),
+                                  rel=os.path.relpath(f, REPO))
+    else:
+        findings = lint_package()
+
+    if args.json:
+        print(json.dumps({"ok": not findings,
+                          "findings": [dataclasses.asdict(f)
+                                       for f in findings]}, indent=2))
+    else:
+        for f in findings:
+            print(f)
+        n = len(findings)
+        scope = f"{len(args.files)} file(s)" if args.files else "package"
+        print(f"lint: {scope}, {n} finding(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,13 @@
+"""Fixture: env reads scripts/lint.py must route through the config.py
+knob registry. Never imported — parsed as AST only (tests/test_lint.py)."""
+import os
+
+
+def read_knobs():
+    a = os.environ.get("MY_TUNABLE", "1")     # bypasses ENV_KNOBS
+    b = os.getenv("OTHER_TUNABLE")            # ditto
+    c = os.environ["REQUIRED_TUNABLE"]        # ditto (subscript read)
+    os.environ["DERIVED"] = "x"               # a WRITE — not flagged
+    os.environ.setdefault("BOOT", "1")        # bootstrap write — not flagged
+    d = os.environ.get("TAGGED", "")  # lint: allow(env-read)
+    return a, b, c, d

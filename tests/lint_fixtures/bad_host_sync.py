@@ -1,0 +1,19 @@
+"""Fixture: every host-sync pattern scripts/lint.py must flag. Never
+imported — parsed as AST only (tests/test_lint.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def traced_body(x, first):
+    y = jax.device_get(x)            # device_get on the hot path
+    z = x.item()                     # .item() sync
+    f = float(jnp.mean(x))           # float() on a device value
+    i = int(jax.device_get(first))   # int() on a device value
+    a = np.asarray(x)                # np.asarray materializes on host
+    return y, z, f, i, a
+
+
+def allowed_body(x):
+    # the tag suppresses exactly one line
+    return jax.device_get(x)  # lint: allow(host-sync)

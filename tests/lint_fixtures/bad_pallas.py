@@ -1,0 +1,12 @@
+"""Fixture: a pallas_call module with no *_usable capability gate.
+Never imported — parsed as AST only (tests/test_lint.py)."""
+from jax.experimental import pallas as pl
+
+
+def kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2
+
+
+def run(x):
+    # no `<something>_usable` gate anywhere in this module -> finding
+    return pl.pallas_call(kernel, out_shape=x)(x)

@@ -1,0 +1,11 @@
+"""Fixture: wall-clock reads scripts/lint.py must flag in obs/ modules.
+Never imported — parsed as AST only (tests/test_lint.py)."""
+import time
+
+
+def record_span():
+    t0 = time.time()                 # NTP slew breaks span durations
+    t1 = time.monotonic()            # fine
+    t2 = time.perf_counter()         # fine
+    anchored = time.time()  # lint: allow(wall-clock)
+    return t0, t1, t2, anchored

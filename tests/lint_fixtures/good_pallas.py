@@ -1,0 +1,17 @@
+"""Fixture: a gated pallas_call module — zero findings expected.
+Never imported — parsed as AST only (tests/test_lint.py)."""
+from jax.experimental import pallas as pl
+
+
+def run_usable() -> bool:
+    return False
+
+
+def kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2
+
+
+def run(x):
+    if not run_usable():
+        return x * 2
+    return pl.pallas_call(kernel, out_shape=x)(x)

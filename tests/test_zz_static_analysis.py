@@ -1,0 +1,408 @@
+"""Round-16 static-analysis subsystem in one suite: the config knob
+registry, TraceGuard retrace accounting, scripts/lint.py rules (each
+demonstrated by a fixture under tests/lint_fixtures/), and the
+device-free shardcheck golden matrix + seeded spec-table mutations.
+
+Named zz_ deliberately: everything here is cheap meta-tooling, and
+sorting it last keeps tier-1's wall-clock budget spent on the
+compile-heavy kernel/recipe parity suites first.
+"""
+
+import importlib.util
+import json
+import pathlib
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from distributed_pytorch_tpu import config
+from distributed_pytorch_tpu.config import (PARALLELISM_RECIPES, PRESETS,
+                                            TrainConfig)
+from distributed_pytorch_tpu.obs.retrace import (RetraceError, TraceGuard,
+                                                 guarded)
+from distributed_pytorch_tpu.parallel import shardcheck, sharding as shd
+from distributed_pytorch_tpu.parallel.mesh import AXES
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+FIXTURES = REPO / "tests" / "lint_fixtures"
+
+# scripts/ is not a package — load by path
+_spec = importlib.util.spec_from_file_location(
+    "repo_lint", REPO / "scripts" / "lint.py")
+lint = importlib.util.module_from_spec(_spec)
+sys.modules["repo_lint"] = lint  # dataclasses resolve types via sys.modules
+_spec.loader.exec_module(lint)
+
+
+# ---------------------------------------------------------------------------
+# config.py env-knob registry
+# ---------------------------------------------------------------------------
+
+def test_knob_defaults_read_without_env():
+    assert config.knob("FLASH_BLOCK_Q") == 256
+    assert config.knob("TRACE_GUARD") == "warn"
+    assert config.knob("FLASH_DECODE") == "auto"
+
+
+def test_knob_env_override_is_live(monkeypatch):
+    """Knob.read consults os.environ per call, so monkeypatch.setenv works
+    mid-process — the property mfu_sweep and the tests depend on."""
+    monkeypatch.setenv("FLASH_BLOCK_Q", "128")
+    assert config.knob("FLASH_BLOCK_Q") == 128
+    monkeypatch.delenv("FLASH_BLOCK_Q")
+    assert config.knob("FLASH_BLOCK_Q") == 256
+
+
+def test_knob_unregistered_name_fails_loudly():
+    with pytest.raises(KeyError):
+        config.knob("FLASH_BLOK_Q")  # typo'd name must not silently default
+
+
+def test_knob_onoff_validation(monkeypatch):
+    monkeypatch.setenv("FLASH_DECODE", "bogus")
+    with pytest.raises(ValueError, match="auto|on|off"):
+        config.knob("FLASH_DECODE")
+    monkeypatch.setenv("FLASH_DECODE", "ON")
+    assert config.knob("FLASH_DECODE") == "on"
+
+
+def test_knobs_table_marks_overrides(monkeypatch):
+    monkeypatch.setenv("FLASH_BLOCK_K", "1024")
+    table = config.knobs_table()
+    lines = {ln.split()[0]: ln for ln in table.splitlines()[1:]}
+    assert set(lines) == set(config.ENV_KNOBS)
+    assert "1024*" in lines["FLASH_BLOCK_K"]      # override marker
+    assert "*" not in lines["FLASH_BLOCK_Q"].split()[2]
+
+
+def test_register_knob_round_trip(monkeypatch):
+    k = config.register_knob("TEST_ONLY_KNOB", "7", int, "test fixture")
+    try:
+        assert config.knob("TEST_ONLY_KNOB") == 7
+        monkeypatch.setenv("TEST_ONLY_KNOB", "9")
+        assert k.read() == 9
+    finally:
+        del config.ENV_KNOBS["TEST_ONLY_KNOB"]
+
+
+# ---------------------------------------------------------------------------
+# obs/retrace.py TraceGuard
+# ---------------------------------------------------------------------------
+
+def test_guard_counts_and_excess():
+    g = TraceGuard("t", budget=2)
+    g.mark()
+    g.mark()
+    assert (g.count, g.excess) == (2, 0)
+    g.mark()  # default mode: warn, not raise
+    assert (g.count, g.excess) == (3, 1)
+    assert g.stats() == {"count": 3, "budget": 2, "excess": 1}
+
+
+def test_guard_allow_raises_budget():
+    g = TraceGuard("t", budget=0)
+    g.allow()
+    g.mark()
+    assert g.excess == 0
+    g.allow(2)
+    g.mark()
+    g.mark()
+    assert (g.count, g.budget, g.excess) == (3, 3, 0)
+
+
+def test_guard_strict_mode_raises(monkeypatch):
+    monkeypatch.setenv("TRACE_GUARD", "strict")
+    g = TraceGuard("t", budget=1)
+    g.mark()
+    with pytest.raises(RetraceError, match="trace #2 exceeds budget 1"):
+        g.mark()
+    assert g.count == 2  # the count still advances
+
+
+def test_guard_warn_mode_logs(monkeypatch, caplog):
+    monkeypatch.setenv("TRACE_GUARD", "warn")
+    g = TraceGuard("t", budget=0)
+    with caplog.at_level("WARNING", logger="retrace"):
+        g.mark()
+    assert any("exceeds budget" in r.message for r in caplog.records)
+
+
+def test_guard_off_mode_is_silent(monkeypatch, caplog):
+    monkeypatch.setenv("TRACE_GUARD", "off")
+    g = TraceGuard("t", budget=0)
+    with caplog.at_level("WARNING", logger="retrace"):
+        g.mark()
+    assert not caplog.records
+    assert g.excess == 1  # still counted for /metrics
+
+
+def test_guard_expect_window(monkeypatch):
+    monkeypatch.setenv("TRACE_GUARD", "strict")
+    g = TraceGuard("t", budget=10)
+    with g.expect(1):
+        g.mark()  # within the window's allowance
+    with pytest.raises(RetraceError):
+        with g.expect(0):
+            g.mark()
+
+
+def test_guarded_fn_delegates():
+    g = TraceGuard("t")
+    fn = guarded(lambda x: x + 1, g)
+    assert fn(1) == 2
+    assert fn.trace_guard is g
+
+
+def test_guard_jit_integration_counts_traces_not_calls():
+    g = TraceGuard("jit", budget=2)
+
+    def f(x):
+        g.mark()  # trace-time side effect
+        return x * 2
+
+    jf = jax.jit(f)
+    jf(jnp.ones((4,)))
+    jf(jnp.ones((4,)))          # cache hit: no new trace
+    assert g.count == 1
+    jf(jnp.ones((8,)))          # new shape: second trace
+    assert (g.count, g.excess) == (2, 0)
+
+
+# ---------------------------------------------------------------------------
+# scripts/lint.py: the package must lint clean, every rule must fire
+# ---------------------------------------------------------------------------
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+def test_lint_package_is_clean():
+    findings = lint.lint_package()
+    assert findings == [], "\n".join(str(f) for f in findings)
+
+
+def test_lint_host_sync_fixture():
+    out = lint.lint_file(FIXTURES / "bad_host_sync.py",
+                         rules=("host-sync",), rel="ops/fixture.py")
+    assert _rules(out) == ["host-sync"] * 6
+    # device_get, .item(), float(jnp...), int(device_get) twice, asarray
+    assert sorted(f.line for f in out) == [9, 10, 11, 12, 12, 13]
+    # the tagged line (19) must not appear
+    assert all(f.line != 19 for f in out)
+
+
+def test_lint_wallclock_fixture():
+    out = lint.lint_file(FIXTURES / "bad_wallclock.py",
+                         rules=("wall-clock",), rel="obs/fixture.py")
+    assert _rules(out) == ["wall-clock"]
+    assert out[0].line == 7
+
+
+def test_lint_env_read_fixture():
+    out = lint.lint_file(FIXTURES / "bad_env.py",
+                         rules=("env-read",), rel="serve/fixture.py")
+    assert _rules(out) == ["env-read"] * 3
+    assert sorted(f.line for f in out) == [7, 8, 9]  # writes not flagged
+
+
+def test_lint_pallas_gate_fixtures():
+    bad = lint.lint_file(FIXTURES / "bad_pallas.py",
+                         rules=("pallas-gate",), rel="ops/fixture.py")
+    assert _rules(bad) == ["pallas-gate"]
+    good = lint.lint_file(FIXTURES / "good_pallas.py",
+                          rules=("pallas-gate",), rel="ops/fixture.py")
+    assert good == []
+
+
+def test_lint_rule_scoping_by_path():
+    """host-sync only applies to hot-path modules: the same fixture under
+    a data-loading path produces no findings with default scoping."""
+    hot = lint.lint_file(FIXTURES / "bad_host_sync.py",
+                         rel="ops/fixture.py")
+    cold = lint.lint_file(FIXTURES / "bad_host_sync.py",
+                          rel="data/fixture.py")
+    assert any(f.rule == "host-sync" for f in hot)
+    assert all(f.rule != "host-sync" for f in cold)
+
+
+def test_lint_wallclock_scoped_to_obs():
+    out = lint.lint_file(FIXTURES / "bad_wallclock.py",
+                         rel="train/fixture.py")
+    assert all(f.rule != "wall-clock" for f in out)
+
+
+def test_lint_main_exit_codes(capsys):
+    # explicit fixture file -> all rules -> findings -> exit 1 (what CI
+    # keys off; the in-process call covers the CLI without paying a
+    # subprocess interpreter start)
+    assert lint.main([str(FIXTURES / "bad_host_sync.py")]) == 1
+    out = capsys.readouterr().out
+    assert "[host-sync]" in out
+    # whole package -> clean -> exit 0
+    assert lint.main([]) == 0
+
+
+# ---------------------------------------------------------------------------
+# shardcheck: the golden matrix
+# ---------------------------------------------------------------------------
+
+def test_matrix_green():
+    """Every recipe x ladder preset x {1x1, 2x1, 4x2} mesh (plus the MoE
+    variant) validates with zero errors, entirely device-free."""
+    reports = shardcheck.check_matrix()
+    # 5 configs (4 ladder rungs + moe'd 124m) x (9 recipes x 3 meshes +
+    # 'single' at 1x1 only)
+    assert len(reports) == 5 * (9 * 3 + 1)
+    bad = [r for r in reports if not r.ok]
+    assert not bad, "\n\n".join(shardcheck.format_report(r) for r in bad)
+
+
+def test_1p5b_tp_cache_warns_but_passes():
+    """gpt2_1p5b has 25 heads: under model=2 the decode cache cannot
+    shard its kv-head axis — a legitimate WARN, never an error."""
+    r = shardcheck.check_config(
+        PRESETS["gpt2_1p5b"](), "tp",
+        shardcheck.mesh_sizes_for("tp", (1, 2)), preset="gpt2_1p5b")
+    assert r.ok
+    assert any(f.rule == "cache" for f in r.warnings)
+
+
+def test_abstract_mesh_matches_real_mesh():
+    """The duck-typed AbstractMesh must drive the tables to the exact
+    specs a real device mesh produces (8 virtual CPU devices, 4x2)."""
+    sizes = {"data": 4, "seq": 1, "expert": 1, "model": 2, "pipe": 1}
+    real = Mesh(np.array(jax.devices()[:8]).reshape(4, 1, 1, 2, 1), AXES)
+    cfg = PRESETS["gpt2_124m"]()
+    shapes = shardcheck.param_shapes(cfg)
+    specs_fake = shd.params_pspecs(shapes, "fsdp_tp",
+                                   shardcheck.AbstractMesh(sizes))
+    specs_real = shd.params_pspecs(shapes, "fsdp_tp", real)
+    assert jax.tree_util.tree_all(jax.tree_util.tree_map(
+        lambda a, b: a == b, specs_fake, specs_real,
+        is_leaf=lambda x: isinstance(x, P)))
+
+
+def test_check_train_config_resolves_mesh():
+    r = shardcheck.check_train_config(
+        PRESETS["gpt2_124m"](), TrainConfig(parallelism="fsdp",
+                                            batch_size=8))
+    assert r.ok and r.recipe == "fsdp" and r.n_params > 100e6
+    assert r.mesh["data"] == 8  # resolved from the 8 virtual CPU devices
+
+
+def test_check_train_config_flags_indivisible_batch():
+    """batch_size=2 cannot split across data=8 — the dryrun path must say
+    so before a run wastes a TPU reservation discovering it."""
+    r = shardcheck.check_train_config(
+        PRESETS["gpt2_124m"](), TrainConfig(parallelism="fsdp",
+                                            batch_size=2))
+    assert any(f.rule == "divisibility" and f.table == "batch"
+               for f in r.errors)
+
+
+# ---------------------------------------------------------------------------
+# shardcheck mutations: corrupt the tables, watch each rule fire
+# ---------------------------------------------------------------------------
+
+def test_mutation_dropped_tp_rule_flags_replicated_large(monkeypatch):
+    """Deleting the tkn_emb TP rule reintroduces the round-1 bug (39% of
+    the 124M params replicated per model shard) — replicated-large must
+    catch it."""
+    monkeypatch.setattr(shd, "_TP_RULES", tuple(
+        r for r in shd._TP_RULES if r[0] != ("tkn_emb", "embedding")))
+    r = shardcheck.check_config(
+        PRESETS["gpt2_124m"](), "tp",
+        shardcheck.mesh_sizes_for("tp", (1, 2)))
+    hits = [f for f in r.errors if f.rule == "replicated-large"]
+    assert hits and any("tkn_emb" in f.path for f in hits)
+    assert not r.ok
+
+
+def test_mutation_out_of_range_axis_flags_replicated_large(monkeypatch):
+    """Flipping a rule's axis index past the tensor rank silently drops
+    the sharding (spec_for_param bounds-checks) — the large c_attn
+    kernels come back replicated and the checker flags them."""
+    rules = tuple((suffix, 5) if suffix == ("c_attn", "kernel")
+                  else (suffix, ax) for suffix, ax in shd._TP_RULES)
+    monkeypatch.setattr(shd, "_TP_RULES", rules)
+    r = shardcheck.check_config(
+        PRESETS["gpt2_124m"](), "tp",
+        shardcheck.mesh_sizes_for("tp", (1, 2)))
+    assert any(f.rule == "replicated-large" and "c_attn" in f.path
+               for f in r.errors)
+
+
+def test_corrupt_specs_flag_structural_rules():
+    """check_spec_tree catches nonexistent axes, axis reuse, and
+    indivisible dims on any spec pytree."""
+    sizes = {"data": 4, "seq": 1, "expert": 1, "model": 2, "pipe": 1}
+    shapes = {"w": (6, 8), "v": (4, 4)}
+    specs = {"w": P("bogus", "model"),    # unknown axis + 8 % 2 == 0 fine
+             "v": P("data", "data")}      # reuse + 4 % 4 == 0 fine
+    findings = shardcheck.check_spec_tree(specs, shapes, sizes)
+    rules = {f.rule for f in findings}
+    assert "axis-name" in rules and "axis-reuse" in rules
+
+    div = shardcheck.check_spec(P(None, "model"), (8, 7), sizes,
+                                table="params", path="w")
+    assert [f.rule for f in div] == ["divisibility"]
+
+
+def test_rank_overflow_flagged():
+    sizes = {"data": 2, "seq": 1, "expert": 1, "model": 1, "pipe": 1}
+    out = shardcheck.check_spec(P("data", None, None), (4, 4), sizes,
+                                table="params", path="w")
+    assert [f.rule for f in out] == ["rank"]
+
+
+def test_indivisible_expert_grid_flagged():
+    """16 experts minus 2 shared = 14 routed: an expert axis of 4 cannot
+    divide them — the checker must flag what GSPMD would reject on
+    hardware."""
+    cfg = PRESETS["gpt2_124m"](moe=True, n_exp=16, n_shared=2, n_act=8)
+    sizes = shardcheck.mesh_sizes_for("ep", (1, 4))
+    r = shardcheck.check_config(cfg, "ep", sizes)
+    assert any(f.rule == "divisibility" and "experts" in f.path
+               for f in r.errors)
+
+
+# ---------------------------------------------------------------------------
+# shardcheck report plumbing + CLI
+# ---------------------------------------------------------------------------
+
+def test_report_json_round_trip():
+    r = shardcheck.check_config(
+        PRESETS["gpt2_124m"](), "fsdp",
+        shardcheck.mesh_sizes_for("fsdp", (4, 1)))
+    payload = json.loads(shardcheck.reports_to_json([r]))
+    assert payload["ok"] and payload["checked"] == 1
+    assert payload["reports"][0]["recipe"] == "fsdp"
+    assert payload["reports"][0]["mesh"]["data"] == 4
+
+
+def test_cli_green_and_red(monkeypatch, capsys, tmp_path):
+    assert shardcheck.main(["--preset", "gpt2_124m", "--recipe", "fsdp_tp",
+                            "--mesh", "4x2"]) == 0
+    out = capsys.readouterr().out
+    assert "OK" in out and "0 error(s)" in out
+
+    json_path = tmp_path / "report.json"
+    monkeypatch.setattr(shd, "_TP_RULES", ())
+    assert shardcheck.main(["--preset", "gpt2_124m", "--recipe", "tp",
+                            "--mesh", "1x2", "--json",
+                            str(json_path)]) == 1
+    payload = json.loads(json_path.read_text())
+    assert not payload["ok"] and payload["errors"] > 0
+
+
+def test_every_recipe_has_a_secondary_axis_mapping():
+    """mesh_sizes_for must place the B grid factor on a real axis for
+    every recipe (data-family recipes compose tp on it)."""
+    for recipe in PARALLELISM_RECIPES:
+        sizes = shardcheck.mesh_sizes_for(recipe, (2, 2))
+        assert sum(1 for s in sizes.values() if s > 1) == 2
+        assert set(sizes) == set(AXES)

@@ -24,6 +24,10 @@ Covered:
   (effective as long as no backend client exists yet; the image's
   sitecustomize imports jax at interpreter start but backends initialize
   lazily, so this still works from conftest/driver code).
+* `enable_cpu_collectives()` — switch the CPU client's cross-process
+  collectives to Gloo-over-TCP; without it 0.4.x defaults to "none" and
+  a multi-process CPU run dies mid-compile with "Multiprocess
+  computations aren't implemented on the CPU backend".
 """
 
 from __future__ import annotations
@@ -97,6 +101,17 @@ def tpu_compiler_params(**kwargs):
     cls = getattr(pltpu, "CompilerParams", None) \
         or getattr(pltpu, "TPUCompilerParams")
     return cls(**kwargs)
+
+
+def enable_cpu_collectives() -> None:
+    """Use Gloo (bundled with jaxlib, TCP over localhost/DCN) for CPU
+    cross-process collectives. Call BEFORE the first backend touch — like
+    `jax.distributed.initialize`, it is too late once a client exists.
+    The flag only affects CPU client creation; harmless if never used."""
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except (AttributeError, ValueError):  # future jax: renamed or default
+        pass
 
 
 def request_cpu_devices(n: int) -> None:

@@ -159,6 +159,24 @@ register_knob("TPU_WORKER_HOSTNAMES", "", str,
 register_knob("MEGASCALE_COORDINATOR_ADDRESS", "", str,
               "multislice (megascale) coordinator announcement")
 
+# --- elastic training (train/supervisor.py + train/checkpoint.py, ISSUE 13) ---
+register_knob("CKPT_VERIFY", "on",
+              lambda s: s.lower() not in ("off", "0", ""),
+              "deep blake2b manifest verification on checkpoint restore "
+              "(train/checkpoint.py); off = structural checks only")
+register_knob("TRAIN_KEEP_CKPTS", "0", int,
+              "checkpoint retention: keep the newest K verified step dirs, "
+              "prune older ones after each save; 0 = keep everything "
+              "(TrainConfig.keep_ckpts overrides when > 0)")
+register_knob("SUPERVISOR_HB_FILE", "", str,
+              "heartbeat file path the supervisor assigns a train worker; "
+              "a worker writes liveness JSON there every interval")
+register_knob("SUPERVISOR_HB_INTERVAL_S", "0.5", float,
+              "seconds between worker heartbeat writes")
+register_knob("SUPERVISOR_CPU_DEVICES", "0", int,
+              "virtual CPU devices a supervisor-spawned worker requests "
+              "before importing jax (compat.request_cpu_devices); 0 = off")
+
 
 ACTIVATIONS = (
     "relu", "gelu", "swish", "mish", "silu", "selu", "celu", "elu",
@@ -422,6 +440,10 @@ class TrainConfig:
     # checkpoint/resume (exceeds reference save-only; SURVEY.md §5)
     ckpt_interval: int = 0           # 0 = end-of-run only
     resume: bool = False
+    keep_ckpts: int = 0              # retention: keep newest K verified
+                                     # step dirs, prune older after each
+                                     # save; 0 defers to TRAIN_KEEP_CKPTS
+                                     # knob (ISSUE 13)
     log_interval: int = 1
     profile: bool = False            # jax.profiler trace capture
     profile_dir: str = ""            # capture output dir; "" = the

@@ -82,6 +82,17 @@ def resolve_plan(recipe: str, n_devices: int, *, tp_size: int = 1,
     return MeshPlan(data=dp, seq=sp, expert=ep, model=tp, pipe=pp)
 
 
+def rung_down(n: int) -> int:
+    """Next power-of-two data-parallel rung strictly below `n` (2→1, 3→2,
+    4→2, 5→4, 8→4). The elastic supervisor (train/supervisor.py) re-meshes
+    the survivors of a dead host onto this count: a power of two keeps
+    every recipe's divisibility constraints (grad-accum, per-shard batch)
+    satisfiable without re-deriving the whole plan. n == 1 has no rung
+    below — callers treat that as 'run lost'."""
+    assert n >= 2, f"no dp rung below {n}"
+    return 1 << ((n - 1).bit_length() - 1)
+
+
 def build_mesh(plan: MeshPlan,
                devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
     """Build the 4-axis mesh. Axis order (data, seq, expert, model) puts

@@ -107,6 +107,13 @@ def maybe_initialize_distributed() -> None:
     from distributed_pytorch_tpu import compat
     if compat.distributed_is_initialized():
         return
+    # A multi-process run pinned to the CPU backend (the two-process tests,
+    # scripts/fault_inject_train.py, host-only debug topologies) needs a
+    # cross-process collectives implementation — 0.4.x defaults to "none"
+    # and fails mid-compile otherwise. Reading jax.config touches no
+    # backend, so this is still early enough.
+    if "cpu" in (jax.config.jax_platforms or "").split(","):
+        compat.enable_cpu_collectives()
     # jax.distributed.initialize() auto-detects only TPU-pod / Slurm / MPI
     # environments; the explicit JAX_* env convention (our launchers, and
     # the round-4 two-process CPU test that caught this) must be passed as
@@ -178,33 +185,37 @@ def _graceful_stop():
     """Preemption-safe shutdown (SURVEY §5: the reference has no failure
     handling at all — torchrun without --max-restarts, no signal handling).
     On SIGTERM — what Cloud TPU preemptible/spot VMs send before reclaim —
-    set a flag the training loop checks (and AGREES on across processes,
-    see _agree_stop) at the top of each iteration, where it writes a
-    checkpoint and exits cleanly; with `--resume` the next run continues
-    the exact stream. Installed only from the main thread (signal API
-    constraint); restores the previous handler on exit.
+    or SIGINT — Ctrl-C on a dev box, which previously killed the process
+    through KeyboardInterrupt and lost everything since the last
+    checkpoint (ISSUE 13 satellite) — set a flag the training loop checks
+    (and AGREES on across processes, see _agree_stop) at the top of each
+    iteration, where it writes a checkpoint and exits cleanly; with
+    `--resume` the next run continues the exact stream. Installed only
+    from the main thread (signal API constraint); restores the previous
+    handlers on exit.
 
     The handler body ONLY sets a flag: calling print/log from a signal
     handler can re-enter a locked stdout buffer mid-write and raise
     RuntimeError in the main thread — the loop logs the event instead."""
-    stop = {"flag": False}
-    prev = None
-    installed = False
+    stop = {"flag": False, "signame": ""}
+    prevs: list[tuple[int, object]] = []
     if threading.current_thread() is threading.main_thread():
         def _handler(signum, frame):
             stop["flag"] = True
-        try:
-            prev = signal.signal(signal.SIGTERM, _handler)
-            installed = True
-        except ValueError:  # pragma: no cover - embedded interpreters
-            pass
+            stop["signame"] = signal.Signals(signum).name
+        for signum in (signal.SIGTERM, signal.SIGINT):
+            try:
+                prevs.append((signum, signal.signal(signum, _handler)))
+            except ValueError:  # pragma: no cover - embedded interpreters
+                pass
     try:
         yield stop
     finally:
         # prev is None when the previous handler was installed from C
         # (not inspectable from Python) — leave ours in place then
-        if installed and prev is not None:
-            signal.signal(signal.SIGTERM, prev)
+        for signum, prev in prevs:
+            if prev is not None:
+                signal.signal(signum, prev)
 
 
 def _agree_stop(local_flag: bool) -> bool:
@@ -221,6 +232,19 @@ def _agree_stop(local_flag: bool) -> bool:
     flags = multihost_utils.process_allgather(
         np.asarray([local_flag], dtype=np.bool_))
     return bool(np.asarray(flags).any())
+
+
+def _prune_ckpts(ckpt_root: str, train_cfg: TrainConfig, say) -> None:
+    """Retention after a save (ISSUE 13 satellite): keep the newest K
+    verified step dirs. K = --keep_ckpts when set, else the
+    TRAIN_KEEP_CKPTS knob; 0 (the default) keeps everything. Only
+    manifest-verified dirs are eligible and the newest good one always
+    survives (train/checkpoint.py::prune_checkpoints)."""
+    keep = train_cfg.keep_ckpts if train_cfg.keep_ckpts > 0 \
+        else cfg_mod.knob("TRAIN_KEEP_CKPTS")
+    if keep > 0:
+        for d in ckpt.prune_checkpoints(ckpt_root, keep):
+            say(f"retention: pruned {d} (keeping newest {keep})")
 
 
 def _atomic_write_json(path: str, obj: dict) -> None:
@@ -350,13 +374,21 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
 
     start_step = 0
     ckpt_root = os.path.join("checkpoints", train_cfg.file_name)
+    resume_info = None  # (path, skipped) for the telemetry recovery event
     if train_cfg.resume:
-        last = ckpt.latest_step_dir(ckpt_root)
-        if last is not None:
-            abstract = jax.tree_util.tree_map(
-                lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), state)
-            state = ckpt.restore_checkpoint(last, abstract, state_sharding)
+        abstract = jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), state)
+        # restore_latest walks newest→oldest past torn/corrupt step dirs
+        # (blake2b manifest verification, train/checkpoint.py) — a flipped
+        # byte in the newest save falls back to the previous good one
+        # instead of crashing the rejoin (ISSUE 13)
+        restored = ckpt.restore_latest(ckpt_root, abstract, state_sharding)
+        if restored is not None:
+            state, last, skipped = restored
             start_step = int(jax.device_get(state.step))
+            resume_info = (last, skipped)
+            for bad in skipped:
+                say(f"resume: skipped unusable checkpoint {bad}")
             say(f"resumed from {last} at step {start_step}")
 
     train_step = make_train_step(model, tx, model_cfg, train_cfg, mesh,
@@ -381,6 +413,17 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
         anomaly=train_cfg.anomaly)
     run_dir = os.path.join("runs", train_cfg.file_name)
     timeline_path = os.path.join(run_dir, "train_timeline.jsonl")
+    if tel.enabled and resume_info is not None:
+        # recovery event on the timeline/metrics (ISSUE 13): which step
+        # dir the run rejoined from, and how many unusable (torn or
+        # corrupt) dirs the manifest walk skipped to get there
+        last, skipped = resume_info
+        tel.metrics.inc("resumes")
+        if skipped:
+            tel.metrics.inc("ckpt_fallbacks", len(skipped))
+        tel.record_step(event="resume", it=start_step,
+                        ckpt=os.path.basename(last),
+                        fallbacks=len(skipped))
     # price the config ACTUALLY in flight once up front; the
     # peak_bytes_in_use watermark is sampled at boundaries below and
     # the delta lands in the timeline, stats.json, and bench JSON
@@ -491,8 +534,9 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
                 path = ckpt.save_checkpoint(
                     os.path.join(ckpt_root, f"step_{step_now}"), state,
                     model_cfg, train_cfg)
-                say(f"[signal] SIGTERM: checkpoint -> {path}; stopping at "
-                    f"iter {it} (resume with --resume)")
+                say(f"[signal] {stop['signame'] or 'SIGTERM'}: checkpoint "
+                    f"-> {path}; stopping at iter {it} "
+                    f"(resume with --resume)")
                 stopped_early = True
                 break
 
@@ -649,6 +693,11 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
                     tel.dump(timeline_path)
                 say(f"checkpoint (async) -> {path} "
                     f"(snapshot {ckpt.last_snapshot_ms:.0f}ms)")
+                # retention: this save's manifest is still pending (its
+                # durability lands at the next wait), so pruning here only
+                # ever deletes OLDER verified dirs — the in-flight one is
+                # untouchable by construction
+                _prune_ckpts(ckpt_root, train_cfg, say)
                 win_t0 = time.perf_counter()       # ckpt time isn't step time
 
     if train_cfg.profile and is_main:
@@ -669,6 +718,7 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
             os.path.join(ckpt_root, f"step_{final}"), state,
             model_cfg, train_cfg)
         say(f"final checkpoint -> {path}")
+    _prune_ckpts(ckpt_root, train_cfg, say)  # after-save retention pass
 
     stats["final_loss"] = stats["train_losses"][-1] if stats["train_losses"] else None
     stats["peak_hbm_gb"] = M.device_memory_gb()

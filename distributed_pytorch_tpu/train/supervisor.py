@@ -1,0 +1,497 @@
+"""Elastic training supervisor: host-failure detection, gang restart,
+and rung-down re-mesh (ISSUE 13 — the ROADMAP pod-scale exit bar "a
+mid-run SIGKILL of one host doesn't lose the run").
+
+`python -m distributed_pytorch_tpu.train.supervisor --hosts N -- <train
+flags>` promotes the tests/test_multihost.py subprocess idiom to a
+subsystem: the supervisor spawns one worker process per host slot (each
+is `--worker` mode of this module, which starts a heartbeat thread and
+then delegates to the normal training CLI), wires the explicit JAX_*
+topology env (fresh coordinator port per gang incarnation), and watches
+the gang with the serve/router.py Replica failure-detector state
+machine applied to train workers:
+
+* **exit-code watch** — the primary signal. A SIGKILLed worker is seen
+  within one poll tick; its death wedges the survivors inside
+  collectives, so recovery is a GANG restart: kill the remainder,
+  respawn all N slots (the victim keeps its process id) with `--resume`
+  appended, under exponential backoff. The restarted gang rejoins from
+  the latest *verified* checkpoint boundary (blake2b manifests,
+  train/checkpoint.py::restore_latest) — the counter-based loader then
+  replays the exact token stream, so a kill/restart on the same mesh
+  reproduces the uninterrupted run bitwise (fault_inject_train.py
+  asserts this).
+* **heartbeat watch** — each worker's daemon thread writes an atomic
+  liveness file every SUPERVISOR_HB_INTERVAL_S; the thread is immune to
+  compile stalls (it is not the training loop), so a stale mtime means
+  the *process* is frozen (SIGSTOP, scheduler wedge) while `poll()`
+  still shows it alive. Stale past --hb-timeout-s → treated as down.
+* **rung-down re-mesh** — a hold file (`runs/<run>/hold_<slot>`, written
+  by an operator or the fault harness) marks a slot as unrestartable.
+  If the victim's slot stays held past --remesh-deadline-s, the
+  supervisor drops the gang one data-parallel rung
+  (parallel/mesh.py::rung_down: 2→1, 3→2, 5→4), respawns the survivors
+  with the reduced process count, and the mesh-portable orbax restore
+  puts the SAME checkpoint onto the smaller mesh. total_batch_size is
+  part of the train argv, so grad-accum rescales automatically and the
+  global batch (hence the data-shard coverage) is unchanged — the
+  re-meshed leg continues the same experiment, just slower.
+
+Everything the supervisor decides lands in two artifacts under
+`runs/<run>/`: `supervisor_state.json` (atomic snapshot: generation,
+worker os_pids, status — the fault harness reads victim pids from here)
+and `supervisor_timeline.jsonl` (obs/flight.py FlightRecorder event
+log: worker_down, heartbeat_timeout, gang_restart, remesh, completed).
+
+The module imports neither jax nor the trainer: worker processes do.
+That keeps the watch loop allocation-free and signal-responsive, and
+means a supervisor crash can never wedge a collective.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Optional, Sequence
+
+from distributed_pytorch_tpu import config as cfg_mod
+from distributed_pytorch_tpu.obs.flight import FlightRecorder
+
+STATE_FILE = "supervisor_state.json"
+TIMELINE_FILE = "supervisor_timeline.jsonl"
+
+#: exit codes (scripts/fault_inject_train.py keys off these)
+EXIT_OK = 0            # every worker exited 0
+EXIT_RESTARTS = 1      # restart budget exhausted
+EXIT_NO_RUNG = 2       # host held dead below the smallest possible mesh
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _rung_down(n: int) -> int:
+    # fs-only mirror of parallel/mesh.py::rung_down (importing the mesh
+    # module would pull jax into the supervisor process);
+    # tests/test_elastic.py pins the two to agree
+    assert n >= 2
+    return 1 << ((n - 1).bit_length() - 1)
+
+
+def _atomic_json(path: str, obj: dict) -> None:
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1)
+    os.replace(tmp, path)
+
+
+def _latest_verified_step(ckpt_root: str) -> Optional[str]:
+    """Newest step dir carrying a manifest whose listed files exist at
+    their recorded sizes — the fs-only shallow check (a mirror of
+    train/checkpoint.py::_complete_step_dir that avoids importing jax
+    into the supervisor). Used for the `resumed_from` report field; the
+    workers do the authoritative deep verification on restore."""
+    if not os.path.isdir(ckpt_root):
+        return None
+    steps = sorted((int(name[5:]), name) for name in os.listdir(ckpt_root)
+                   if name.startswith("step_") and name[5:].isdigit())
+    for _, name in reversed(steps):
+        path = os.path.join(ckpt_root, name)
+        mpath = os.path.join(path, "manifest.json")
+        try:
+            with open(mpath) as f:
+                files = json.load(f)["files"]
+            if all(os.path.exists(os.path.join(path, rel))
+                   and os.path.getsize(os.path.join(path, rel))
+                   == meta["bytes"] for rel, meta in files.items()):
+                return path
+        except (OSError, ValueError, KeyError):
+            continue
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Worker mode: heartbeat thread + delegate to the training CLI.
+# ---------------------------------------------------------------------------
+
+def _start_heartbeat(path: str, interval_s: float) -> threading.Thread:
+    """Daemon thread writing an atomic liveness file every interval.
+
+    Runs beside (not inside) the training loop, so a multi-minute XLA
+    compile does not read as death — only a frozen/stopped PROCESS
+    starves the file's mtime."""
+    pid = os.getpid()
+
+    def beat():
+        seq = 0
+        while True:
+            try:
+                _atomic_json(path, {"pid": pid, "seq": seq})
+            except OSError:
+                pass  # a torn disk must not kill the worker
+            seq += 1
+            time.sleep(interval_s)
+
+    t = threading.Thread(target=beat, name="supervisor-heartbeat",
+                         daemon=True)
+    t.start()
+    return t
+
+
+def worker_main(argv: Sequence[str]) -> None:
+    """`--worker` entry: start the heartbeat (SUPERVISOR_HB_FILE knob),
+    request virtual CPU devices when asked (SUPERVISOR_CPU_DEVICES —
+    must happen before any jax device op), then run the standard
+    training CLI with `argv`."""
+    hb_path = cfg_mod.knob("SUPERVISOR_HB_FILE")
+    if hb_path:
+        _start_heartbeat(hb_path, cfg_mod.knob("SUPERVISOR_HB_INTERVAL_S"))
+    n_cpu = cfg_mod.knob("SUPERVISOR_CPU_DEVICES")
+    if n_cpu > 0:
+        from distributed_pytorch_tpu import compat
+        compat.request_cpu_devices(n_cpu)
+    from distributed_pytorch_tpu.__main__ import main
+    main(list(argv))
+
+
+# ---------------------------------------------------------------------------
+# Supervisor.
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class SupervisorConfig:
+    """Knobs of the watch loop (CLI flags of the same names)."""
+
+    hosts: int
+    train_argv: tuple[str, ...] = ()
+    run_name: str = "llm_model"
+    hb_timeout_s: float = 120.0    # generous: must tolerate jax import
+    poll_s: float = 0.1
+    max_restarts: int = 8
+    backoff_base_s: float = 0.5
+    backoff_cap_s: float = 8.0
+    remesh_deadline_s: float = 5.0
+    cpu_devices: int = 0           # per-worker virtual CPU devices
+    hb_interval_s: float = 0.5
+
+
+@dataclasses.dataclass
+class _Slot:
+    """One host slot of the current gang incarnation."""
+
+    slot: int
+    proc: subprocess.Popen
+    hb_path: str
+    spawned: float                 # monotonic
+
+
+class Supervisor:
+    """Spawn, watch, and restart a train-worker gang (module docstring).
+
+    `worker_cmd(slot, n_hosts, resume)` -> argv builds one worker's
+    command line; the default runs this module's `--worker` mode with
+    the configured train argv (+ `--resume` after the first
+    incarnation). Tests inject a stub command to exercise the state
+    machine without paying a jax import per worker."""
+
+    def __init__(self, cfg: SupervisorConfig,
+                 worker_cmd: Optional[
+                     Callable[[int, int, bool], list[str]]] = None,
+                 log: Callable[[str], None] = print):
+        self.cfg = cfg
+        self.worker_cmd = worker_cmd or self._default_worker_cmd
+        self.log = log
+        self.run_dir = os.path.join("runs", cfg.run_name)
+        self.ckpt_root = os.path.join("checkpoints", cfg.run_name)
+        self.flight = FlightRecorder(capacity=4096)
+        self.generation = 0
+        self.n_hosts = cfg.hosts
+        self.restarts = 0
+        self._stop = False
+        os.makedirs(self.run_dir, exist_ok=True)
+
+    # ---- helpers --------------------------------------------------------
+
+    def _default_worker_cmd(self, slot: int, n: int,
+                            resume: bool) -> list[str]:
+        argv = list(self.cfg.train_argv)
+        if resume and "--resume" not in argv:
+            argv.append("--resume")
+        return [sys.executable, "-m",
+                "distributed_pytorch_tpu.train.supervisor",
+                "--worker", "--", *argv]
+
+    def _event(self, event: str, **fields) -> None:
+        self.flight.record(event=event, **fields)
+        self.flight.dump_jsonl(os.path.join(self.run_dir, TIMELINE_FILE))
+        kv = " ".join(f"{k}={v}" for k, v in fields.items())
+        self.log(f"[supervisor] {event} {kv}".rstrip())
+
+    def _write_state(self, status: str, slots: Sequence[_Slot]) -> None:
+        _atomic_json(os.path.join(self.run_dir, STATE_FILE), {
+            "run": self.cfg.run_name,
+            "status": status,
+            "generation": self.generation,
+            "n_hosts": self.n_hosts,
+            "restarts": self.restarts,
+            "workers": [{"slot": s.slot, "os_pid": s.proc.pid,
+                         "alive": s.proc.poll() is None} for s in slots],
+            "resumed_from": _latest_verified_step(self.ckpt_root),
+        })
+
+    def _hold_path(self, slot: int) -> str:
+        return os.path.join(self.run_dir, f"hold_{slot}")
+
+    def _spawn_gang(self, resume: bool) -> list[_Slot]:
+        n = self.n_hosts
+        self.generation += 1
+        port = _free_port()  # fresh coordinator per incarnation: the old
+        # one may linger in TIME_WAIT or still be owned by a dying worker
+        slots = []
+        for i in range(n):
+            hb = os.path.join(self.run_dir, f"hb_{i}.json")
+            try:
+                os.remove(hb)  # a stale beat must not mask a dead spawn
+            except OSError:
+                pass
+            env = dict(os.environ)
+            for k in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                      "JAX_PROCESS_ID"):
+                env.pop(k, None)
+            if n > 1:  # n == 1: single-process, no coordinator at all
+                env["JAX_COORDINATOR_ADDRESS"] = f"localhost:{port}"
+                env["JAX_NUM_PROCESSES"] = str(n)
+                env["JAX_PROCESS_ID"] = str(i)
+            env["SUPERVISOR_HB_FILE"] = hb
+            env["SUPERVISOR_HB_INTERVAL_S"] = str(self.cfg.hb_interval_s)
+            if self.cfg.cpu_devices > 0:
+                env["SUPERVISOR_CPU_DEVICES"] = str(self.cfg.cpu_devices)
+                # the worker's own request must be authoritative — an
+                # inherited device-count flag would override it
+                env.pop("XLA_FLAGS", None)
+            logf = open(os.path.join(
+                self.run_dir, f"worker_{i}.gen{self.generation}.log"), "w")
+            with logf:  # child keeps its duplicated fd past this scope
+                proc = subprocess.Popen(
+                    self.worker_cmd(i, n, resume), env=env,
+                    stdout=logf, stderr=subprocess.STDOUT)
+            slots.append(_Slot(slot=i, proc=proc, hb_path=hb,
+                               spawned=time.monotonic()))
+        self._event("gang_spawn", generation=self.generation, n_hosts=n,
+                    resume=resume,
+                    os_pids=[s.proc.pid for s in slots])
+        return slots
+
+    def _hb_stale(self, s: _Slot) -> bool:
+        try:
+            last = os.path.getmtime(s.hb_path)
+            age = time.time() - last  # mtime is wall-clock
+        except OSError:
+            # no beat yet: age from spawn (covers interpreter start)
+            age = time.monotonic() - s.spawned
+        return age > self.cfg.hb_timeout_s
+
+    def _watch(self, slots: list[_Slot]):
+        """Poll until the gang completes or a worker goes down.
+
+        Returns ("done", None, "") when every worker exited 0, else
+        ("down", slot, reason) for the first observed failure."""
+        while True:
+            if self._stop:
+                return ("down", None, "supervisor_stopped")
+            codes = [s.proc.poll() for s in slots]
+            for s, rc in zip(slots, codes):
+                if rc is not None and rc != 0:
+                    return ("down", s.slot, f"exit_{rc}")
+                if rc is None and self._hb_stale(s):
+                    return ("down", s.slot, "heartbeat_timeout")
+            if all(rc == 0 for rc in codes):
+                return ("done", None, "")
+            self._write_state("running", slots)
+            time.sleep(self.cfg.poll_s)
+
+    def _kill_gang(self, slots: list[_Slot]) -> None:
+        # SIGKILL, not SIGTERM: survivors of a dead peer are wedged
+        # inside collectives and will never reach the graceful-stop
+        # flag check; the verified-checkpoint contract makes the hard
+        # kill safe (a torn in-flight save is manifest-less → skipped)
+        for s in slots:
+            if s.proc.poll() is None:
+                s.proc.kill()
+        for s in slots:
+            try:
+                s.proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:  # pragma: no cover
+                pass
+
+    # ---- main loop ------------------------------------------------------
+
+    def run(self) -> int:
+        """Drive gangs to completion; returns an EXIT_* code."""
+        prevs: list[tuple[int, object]] = []
+        if threading.current_thread() is threading.main_thread():
+            def _sig(signum, frame):
+                self._stop = True
+            for signum in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    prevs.append((signum, signal.signal(signum, _sig)))
+                except ValueError:  # pragma: no cover
+                    pass
+        try:
+            return self._run()
+        finally:
+            for signum, prev in prevs:
+                if prev is not None:
+                    signal.signal(signum, prev)
+
+    def _run(self) -> int:
+        resume = "--resume" in self.cfg.train_argv
+        while True:
+            slots = self._spawn_gang(resume)
+            self._write_state("running", slots)
+            what, victim, reason = self._watch(slots)
+            if what == "done":
+                self._event("completed", generation=self.generation,
+                            n_hosts=self.n_hosts)
+                self._write_state("completed", slots)
+                return EXIT_OK
+            self._event("worker_down", slot=victim, reason=reason,
+                        generation=self.generation)
+            self._kill_gang(slots)
+            if self._stop:
+                self._event("stopped", generation=self.generation)
+                self._write_state("stopped", slots)
+                return 128 + signal.SIGTERM
+            resume = True  # every later incarnation rejoins the run
+
+            # hold watch: the victim's slot may be marked unrestartable
+            # (dead host). Wait for release up to the re-mesh deadline.
+            deadline = time.monotonic() + self.cfg.remesh_deadline_s
+            held = victim is not None and \
+                os.path.exists(self._hold_path(victim))
+            if held:
+                self._event("hold_wait", slot=victim,
+                            deadline_s=self.cfg.remesh_deadline_s)
+                self._write_state("waiting_hold", slots)
+                while (os.path.exists(self._hold_path(victim))
+                       and time.monotonic() < deadline and not self._stop):
+                    time.sleep(self.cfg.poll_s)
+                held = os.path.exists(self._hold_path(victim))
+
+            if held:
+                # host stayed dead past the deadline: re-mesh one dp
+                # rung down and continue on the survivors
+                if self.n_hosts < 2:
+                    self._event("failed", reason="no_rung_below",
+                                n_hosts=self.n_hosts)
+                    self._write_state("failed", slots)
+                    return EXIT_NO_RUNG
+                new_n = _rung_down(self.n_hosts)
+                self._event("remesh", old_n=self.n_hosts, new_n=new_n,
+                            resumed_from=_latest_verified_step(
+                                self.ckpt_root))
+                for i in range(self.n_hosts):  # stale topology markers
+                    try:
+                        os.remove(self._hold_path(i))
+                    except OSError:
+                        pass
+                self.n_hosts = new_n
+                self.restarts = 0  # fresh topology, fresh budget
+            else:
+                self.restarts += 1
+                if self.restarts > self.cfg.max_restarts:
+                    self._event("failed", reason="restart_budget",
+                                restarts=self.restarts)
+                    self._write_state("failed", slots)
+                    return EXIT_RESTARTS
+
+            backoff = min(self.cfg.backoff_cap_s,
+                          self.cfg.backoff_base_s
+                          * (2 ** max(0, self.restarts - 1)))
+            self._event("gang_restart", generation=self.generation + 1,
+                        n_hosts=self.n_hosts, backoff_s=round(backoff, 3),
+                        resumed_from=_latest_verified_step(self.ckpt_root))
+            time.sleep(backoff)
+
+
+# ---------------------------------------------------------------------------
+# CLI.
+# ---------------------------------------------------------------------------
+
+def _split_argv(argv: Sequence[str]) -> tuple[list[str], list[str]]:
+    """Split at the first bare `--`: supervisor flags | train argv."""
+    argv = list(argv)
+    if "--" in argv:
+        i = argv.index("--")
+        return argv[:i], argv[i + 1:]
+    return argv, []
+
+
+def _run_name_from(train_argv: Sequence[str]) -> str:
+    argv = list(train_argv)
+    if "--file_name" in argv:
+        i = argv.index("--file_name")
+        if i + 1 < len(argv):
+            return argv[i + 1]
+    return "llm_model"
+
+
+def cli(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "--worker":
+        _, train_argv = _split_argv(argv)
+        worker_main(train_argv)
+        return 0
+
+    sup_argv, train_argv = _split_argv(argv)
+    p = argparse.ArgumentParser(
+        prog="python -m distributed_pytorch_tpu.train.supervisor",
+        description="Elastic training supervisor: spawn N train workers, "
+                    "gang-restart on failure, rung-down re-mesh on a "
+                    "held-dead host. Train flags go after `--`.")
+    p.add_argument("--hosts", type=int, required=True)
+    p.add_argument("--run-name", type=str, default=None,
+                   help="runs/<name> artifact dir; default: --file_name "
+                        "from the train argv")
+    p.add_argument("--hb-timeout-s", type=float, default=120.0)
+    p.add_argument("--hb-interval-s", type=float, default=None,
+                   help="default: the SUPERVISOR_HB_INTERVAL_S knob")
+    p.add_argument("--poll-s", type=float, default=0.1)
+    p.add_argument("--max-restarts", type=int, default=8)
+    p.add_argument("--backoff-base-s", type=float, default=0.5)
+    p.add_argument("--backoff-cap-s", type=float, default=8.0)
+    p.add_argument("--remesh-deadline-s", type=float, default=5.0)
+    p.add_argument("--cpu-devices", type=int, default=0,
+                   help="virtual CPU devices per worker (CPU smoke runs)")
+    args = p.parse_args(sup_argv)
+
+    cfg = SupervisorConfig(
+        hosts=args.hosts,
+        train_argv=tuple(train_argv),
+        run_name=args.run_name or _run_name_from(train_argv),
+        hb_timeout_s=args.hb_timeout_s,
+        hb_interval_s=(args.hb_interval_s if args.hb_interval_s is not None
+                       else cfg_mod.knob("SUPERVISOR_HB_INTERVAL_S")),
+        poll_s=args.poll_s,
+        max_restarts=args.max_restarts,
+        backoff_base_s=args.backoff_base_s,
+        backoff_cap_s=args.backoff_cap_s,
+        remesh_deadline_s=args.remesh_deadline_s,
+        cpu_devices=args.cpu_devices,
+    )
+    return Supervisor(cfg).run()
+
+
+if __name__ == "__main__":
+    sys.exit(cli())

@@ -60,7 +60,7 @@ class TrainMetrics:
     from the main thread while the TelemetryServer thread renders)."""
 
     COUNTERS = ("steps", "checkpoints", "anomalies", "updates_skipped",
-                "evals")
+                "evals", "resumes", "ckpt_fallbacks")
 
     def __init__(self):
         self._lock = threading.Lock()

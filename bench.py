@@ -462,6 +462,12 @@ def _serve_bench(platform: str) -> dict:
         if len(rec):
             arts["trace"] = rec.dump_jsonl(
                 os.path.join(art_dir, "trace.jsonl"))
+        # replay the fresh artifacts into the per-phase report + fitted
+        # cost model (obs/replay.py) — the post-hoc analysis inline
+        from distributed_pytorch_tpu.obs import replay
+        rep = replay.write_report(art_dir)
+        arts["report_md"] = rep["report_md"]
+        arts["cost_model_json"] = rep["cost_model_json"]
         out["artifacts"] = arts
     except Exception as e:  # noqa: BLE001 — artifacts never sink the leg
         out["artifacts_error"] = repr(e)
@@ -681,6 +687,14 @@ def _serve_chunked_bench(platform: str) -> dict:
     # steady fused step across BOTH load points (raw ms across chunk
     # sizes compares different fused steps — not the boundedness claim)
     best_c, best = min(by_chunk.items(), key=lambda kv: worst_ratio(kv[1]))
+    if artifacts:
+        try:
+            from distributed_pytorch_tpu.obs import replay
+            rep = replay.write_report(art_dir)
+            artifacts["report_md"] = rep["report_md"]
+            artifacts["cost_model_json"] = rep["cost_model_json"]
+        except Exception:  # noqa: BLE001 — artifacts never sink the leg
+            pass
     accept = {
         # the acceptance bar (ISSUE 7): at a load point where the wave's
         # ITL p99 exceeds 3x its step (the admission stall), some chunk

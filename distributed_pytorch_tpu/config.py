@@ -177,6 +177,26 @@ register_knob("SUPERVISOR_CPU_DEVICES", "0", int,
               "virtual CPU devices a supervisor-spawned worker requests "
               "before importing jax (compat.request_cpu_devices); 0 = off")
 
+# --- fleet observability (serve/router.py, obs/slo.py, obs/replay.py,
+# ISSUE 14) ---
+register_knob("FLEET_POLL_INTERVAL_S", "1.0", float,
+              "min seconds between the router's /metrics.json federation "
+              "pulls per replica (rides the health-probe cadence)")
+register_knob("SLO_TTFT_P99_S", "0.5", float,
+              "TTFT p99 latency SLO threshold in seconds (a "
+              "LATENCY_BUCKETS edge keeps bucket counting exact)")
+register_knob("SLO_ITL_P99_S", "0.05", float,
+              "ITL p99 latency SLO threshold in seconds (a "
+              "LATENCY_BUCKETS edge keeps bucket counting exact)")
+register_knob("SLO_AVAILABILITY", "0.999", float,
+              "availability objective: completed/(completed+shed+failed)")
+register_knob("SLO_WINDOWS_S", "300,3600",
+              lambda s: tuple(float(x) for x in s.split(",") if x.strip()),
+              "comma-separated burn-rate windows in seconds")
+register_knob("OBS_REPORT_MAX_MAE_PCT", "20", float,
+              "obs_report acceptance bar: max median absolute pct error "
+              "of the fitted step-time model before the fit is flagged")
+
 
 ACTIVATIONS = (
     "relu", "gelu", "swish", "mish", "silu", "selu", "celu", "elu",

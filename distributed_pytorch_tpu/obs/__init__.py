@@ -24,13 +24,25 @@ records ({it, loss, grad_norm, step_ms, data_ms, sync_ms, ckpt_ms}),
 a Prometheus registry on serve/metrics.py machinery, the loss/grad
 anomaly monitor, and an opt-in live HTTP endpoint — dumped to
 `runs/<run>/train_timeline.jsonl` like the serve legs' timelines.
+
+The FLEET side (ISSUE 14) closes the loop across processes:
+
+* `obs.slo` — declarative SLO targets (TTFT/ITL p99, availability)
+  with multi-window burn rates and error-budget gauges, computed from
+  the router's federated metrics and exported on its `/metrics`.
+* `obs.replay` — the deterministic read side of every recorder: loads
+  any `runs/<run>/` timeline set, computes per-phase distributions,
+  fits the PERF.md latency models, and emits `report.md` +
+  `cost_model.json` (the trace-replay simulator's cost tables).
 """
 
 from distributed_pytorch_tpu.obs.flight import FlightRecorder
 from distributed_pytorch_tpu.obs.retrace import (RetraceError, TraceGuard,
                                                  guarded)
+from distributed_pytorch_tpu.obs.slo import SLOTarget, SLOTracker
 from distributed_pytorch_tpu.obs.trace import (TraceRecorder, get_recorder,
                                                new_trace_id, set_recorder)
 
-__all__ = ["FlightRecorder", "RetraceError", "TraceGuard", "TraceRecorder",
-           "get_recorder", "guarded", "new_trace_id", "set_recorder"]
+__all__ = ["FlightRecorder", "RetraceError", "SLOTarget", "SLOTracker",
+           "TraceGuard", "TraceRecorder", "get_recorder", "guarded",
+           "new_trace_id", "set_recorder"]

@@ -1,0 +1,384 @@
+"""Deterministic timeline replay: turn any `runs/<run>/` into per-phase
+distributions and a fitted cost model.
+
+The recorders write append-only JSONL (engine `timeline.jsonl` flight
+records, request `trace.jsonl` spans, `train_timeline.jsonl` iteration
+records, `supervisor_timeline.jsonl` gang events); this module is the
+read side — it classifies every `*.jsonl` in a run dir by record shape,
+computes distributions, and fits the PERF.md latency models by
+least-squares regression over the recorded steps:
+
+* **ITL model** (rounds 10/12): `step_ms ≈ a + b · prefill_tokens` — a
+  pure-decode step costs `a`, each chunked-prefill token rides at `b`
+  on top; under chunked prefill ITL *is* one fused step, so `a` is the
+  fitted ITL floor and `b` the chunk-compute slope. Warmup/compile
+  steps (step_ms far above the median) are excluded from the fit and
+  counted, the round-10 methodology for reading a timeline.
+* **TTFT model** (round 10): `TTFT ≈ queue wait + prefill` — assembled
+  from the `sched.queue` and `sched.prefill` span distributions.
+
+The emitted `cost_model.json` is the machine-readable table the ROADMAP
+trace-replay simulator consumes; `report.md` is the same content for
+humans. Everything is stdlib, deterministic (no clocks, no randomness),
+and device-free — it runs on any checkout against any run dir.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Optional
+
+from ..config import knob
+
+#: steps slower than this multiple of the median are compile/warmup
+#: outliers, excluded from the step-model fit (still counted).
+_WARMUP_X_MEDIAN = 10.0
+
+
+# ---------------------------------------------------------------- stats
+def _pct(sorted_vals: list[float], q: float) -> float:
+    idx = min(len(sorted_vals) - 1,
+              max(0, round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def dist(vals: list[float], nd: int = 3) -> dict:
+    """n/mean/p50/p90/p99/max summary of a sample list."""
+    if not vals:
+        return {"n": 0}
+    s = sorted(vals)
+    return {"n": len(s),
+            "mean": round(sum(s) / len(s), nd),
+            "p50": round(_pct(s, 0.50), nd),
+            "p90": round(_pct(s, 0.90), nd),
+            "p99": round(_pct(s, 0.99), nd),
+            "max": round(s[-1], nd)}
+
+
+def fit_linear(xs: list[float], ys: list[float]) -> tuple[float, float]:
+    """Least-squares `y = a + b·x`; b = 0 when x carries no variance
+    (e.g. a decode-only timeline where prefill_tokens is always 0)."""
+    n = len(xs)
+    mx = sum(xs) / n
+    my = sum(ys) / n
+    var = sum((x - mx) ** 2 for x in xs)
+    if var <= 0.0:
+        return my, 0.0
+    b = sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / var
+    return my - b * mx, b
+
+
+def _mae_pct(pred: list[float], actual: list[float]) -> Optional[float]:
+    """Median absolute percentage error of a prediction."""
+    errs = [abs(p - a) / a for p, a in zip(pred, actual) if a > 0]
+    if not errs:
+        return None
+    return round(_pct(sorted(errs), 0.50) * 100.0, 2)
+
+
+# ------------------------------------------------------------ discovery
+def _read_jsonl(path: str) -> list[dict]:
+    recs = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    recs.append(rec)
+    except OSError:
+        return []
+    return recs
+
+
+def _classify(rec: dict) -> Optional[str]:
+    if "it" in rec and "loss" in rec:
+        return "train"
+    if "event" in rec:
+        return "supervisor"
+    if "trace" in rec and "name" in rec:
+        return "trace"
+    if "step" in rec and "step_ms" in rec:
+        return "engine"
+    return None
+
+
+def discover(run_dir: str) -> dict:
+    """Classify every `*.jsonl` under run_dir (one level deep — run
+    dirs nest per-replica artifacts flat) by its first record's shape."""
+    out: dict = {"engine": [], "trace": [], "train": [],
+                 "supervisor": [], "skipped": []}
+    names = []
+    for root, _dirs, files in os.walk(run_dir):
+        for fn in files:
+            if fn.endswith(".jsonl"):
+                names.append(os.path.join(root, fn))
+    for path in sorted(names):
+        recs = _read_jsonl(path)
+        kind = _classify(recs[0]) if recs else None
+        if kind is None:
+            out["skipped"].append(path)
+        else:
+            out[kind].append(path)
+    return out
+
+
+# ------------------------------------------------------------- sections
+def _analyze_engine(paths: list[str]) -> Optional[dict]:
+    recs = [r for p in paths for r in _read_jsonl(p)
+            if "step_ms" in r]
+    if not recs:
+        return None
+    step_ms = [float(r["step_ms"]) for r in recs]
+    med = _pct(sorted(step_ms), 0.50)
+    cut = med * _WARMUP_X_MEDIAN
+    fitted = [r for r in recs if float(r["step_ms"]) <= cut]
+    warmup = len(recs) - len(fitted)
+    xs = [float(r.get("prefill_tokens", 0)) for r in fitted]
+    ys = [float(r["step_ms"]) for r in fitted]
+    a, b = fit_linear(xs, ys) if fitted else (0.0, 0.0)
+    pred = [a + b * x for x in xs]
+    decode = [y for x, y in zip(xs, ys) if x == 0]
+    prefill_steps = [x for x in xs if x > 0]
+    out = {
+        "files": paths,
+        "steps": len(recs),
+        "step_ms": dist(step_ms),
+        "decode_step_ms": dist(decode),
+        "prefill_tokens_per_step": dist(prefill_steps, nd=1),
+        "n_live": dist([float(r.get("n_live", 0)) for r in recs], nd=2),
+        "preemptions": sum(int(r.get("preemptions", 0)) for r in recs),
+        "step_model": {
+            "a_ms": round(a, 4),
+            "b_ms_per_prefill_token": round(b, 6),
+            "mae_pct": _mae_pct(pred, ys),
+            "n_fit": len(fitted),
+            "warmup_excluded": warmup,
+        },
+    }
+    return out
+
+
+def _analyze_trace(paths: list[str]) -> Optional[dict]:
+    spans: dict[str, list[float]] = {}
+    for p in paths:
+        for r in _read_jsonl(p):
+            if "dur" not in r or "name" not in r:
+                continue
+            spans.setdefault(r["name"], []).append(
+                float(r["dur"]) * 1e3)
+    if not spans:
+        return None
+    phases = {name: dist(vals) for name, vals in sorted(spans.items())}
+    out: dict = {"files": paths, "phases": phases}
+    q = spans.get("sched.queue")
+    pf = spans.get("sched.prefill")
+    if q and pf:
+        out["ttft_model"] = {
+            "queue_ms": dist(q),
+            "prefill_ms": dist(pf),
+            "predicted_ttft_p50_ms": round(
+                _pct(sorted(q), 0.5) + _pct(sorted(pf), 0.5), 3),
+        }
+    return out
+
+
+def _analyze_train(paths: list[str]) -> Optional[dict]:
+    recs = [r for p in paths for r in _read_jsonl(p) if "it" in r]
+    if not recs:
+        return None
+
+    def col(key):
+        return [float(r[key]) for r in recs if key in r]
+
+    losses = col("loss")
+    return {
+        "files": paths,
+        "iterations": len(recs),
+        "step_ms": dist(col("step_ms")),
+        "data_ms": dist(col("data_ms")),
+        "sync_ms": dist(col("sync_ms")),
+        "ckpt_ms": dist(col("ckpt_ms")),
+        "tokens_per_s": dist(col("tokens_per_s"), nd=1),
+        "grad_norm": dist(col("grad_norm")),
+        "loss_first": round(losses[0], 4) if losses else None,
+        "loss_last": round(losses[-1], 4) if losses else None,
+        "compile_windows": sum(1 for r in recs
+                               if r.get("compile_window")),
+    }
+
+
+def _analyze_supervisor(paths: list[str]) -> Optional[dict]:
+    recs = [r for p in paths for r in _read_jsonl(p) if "event" in r]
+    if not recs:
+        return None
+    counts: dict[str, int] = {}
+    for r in recs:
+        counts[r["event"]] = counts.get(r["event"], 0) + 1
+    # recovery latency: each worker_down to the next gang_restart (the
+    # supervisor's detect -> kill -> respawn path, PERF.md round 17)
+    recovery = []
+    down_t: Optional[float] = None
+    for r in recs:
+        t = r.get("t")
+        if t is None:
+            continue
+        if r["event"] == "worker_down" and down_t is None:
+            down_t = float(t)
+        elif r["event"] in ("gang_restart", "remesh") \
+                and down_t is not None:
+            recovery.append(float(t) - down_t)
+            down_t = None
+    final = recs[-1]["event"]
+    return {
+        "files": paths,
+        "events": dict(sorted(counts.items())),
+        "restarts": counts.get("gang_restart", 0),
+        "remeshes": counts.get("remesh", 0),
+        "recovery_s": dist(recovery),
+        "final_event": final,
+    }
+
+
+# --------------------------------------------------------------- driver
+def analyze(run_dir: str) -> dict:
+    """Replay one run dir into distributions + fitted models. Returns a
+    dict whose `degenerate` flag means 'no usable timeline records at
+    all' — the CI gate for an empty/broken run."""
+    files = discover(run_dir)
+    engine = _analyze_engine(files["engine"])
+    trace = _analyze_trace(files["trace"])
+    train = _analyze_train(files["train"])
+    sup = _analyze_supervisor(files["supervisor"])
+    sections = {"engine": engine, "trace": trace, "train": train,
+                "supervisor": sup}
+    notes = []
+    max_mae = knob("OBS_REPORT_MAX_MAE_PCT")
+    if engine is not None:
+        mae = engine["step_model"]["mae_pct"]
+        if mae is not None and mae > max_mae:
+            notes.append(
+                f"step-model median abs error {mae}% exceeds the "
+                f"{max_mae}% bar (OBS_REPORT_MAX_MAE_PCT)")
+    return {
+        "run_dir": os.path.abspath(run_dir),
+        "files": files,
+        **sections,
+        "degenerate": all(s is None for s in sections.values()),
+        "notes": notes,
+    }
+
+
+def _md_table(d: dict) -> str:
+    keys = list(d.keys())
+    head = "| " + " | ".join(keys) + " |"
+    sep = "|" + "|".join("---" for _ in keys) + "|"
+    row = "| " + " | ".join(str(d[k]) for k in keys) + " |"
+    return "\n".join([head, sep, row])
+
+
+def _render_md(a: dict) -> str:
+    L = [f"# Timeline replay: `{os.path.basename(a['run_dir'])}`", ""]
+    if a["degenerate"]:
+        L += ["**DEGENERATE:** no usable timeline records found — "
+              "nothing to fit.", ""]
+    for note in a["notes"]:
+        L += [f"> **warning:** {note}", ""]
+    eng = a.get("engine")
+    if eng:
+        m = eng["step_model"]
+        L += ["## Engine (fused decode steps)", "",
+              f"{eng['steps']} steps over {len(eng['files'])} timeline "
+              f"file(s); {m['warmup_excluded']} warmup/compile "
+              "step(s) excluded from the fit.", "",
+              "### Step-time model (ITL ≈ step + chunk compute)", "",
+              f"`step_ms ≈ {m['a_ms']} + {m['b_ms_per_prefill_token']}"
+              " · prefill_tokens`  —  median abs error "
+              f"{m['mae_pct']}% over {m['n_fit']} steps.", "",
+              "### Distributions", ""]
+        for key in ("step_ms", "decode_step_ms",
+                    "prefill_tokens_per_step", "n_live"):
+            if eng[key].get("n"):
+                L += [f"**{key}**", "", _md_table(eng[key]), ""]
+    tr = a.get("trace")
+    if tr:
+        L += ["## Request phases (trace spans, ms)", ""]
+        for name, d in tr["phases"].items():
+            L += [f"**{name}**", "", _md_table(d), ""]
+        tm = tr.get("ttft_model")
+        if tm:
+            L += ["### TTFT model (TTFT ≈ queue + prefill)", "",
+                  "`predicted p50 TTFT = "
+                  f"{tm['predicted_ttft_p50_ms']} ms` "
+                  "(p50 queue + p50 prefill).", ""]
+    trn = a.get("train")
+    if trn:
+        L += ["## Training", "",
+              f"{trn['iterations']} iterations; loss "
+              f"{trn['loss_first']} → {trn['loss_last']}; "
+              f"{trn['compile_windows']} compile window(s).", ""]
+        for key in ("step_ms", "data_ms", "sync_ms", "ckpt_ms",
+                    "tokens_per_s", "grad_norm"):
+            if trn[key].get("n"):
+                L += [f"**{key}**", "", _md_table(trn[key]), ""]
+    sup = a.get("supervisor")
+    if sup:
+        L += ["## Supervisor (gang events)", "",
+              f"events: `{sup['events']}`; final: "
+              f"`{sup['final_event']}`.", ""]
+        if sup["recovery_s"].get("n"):
+            L += ["**recovery latency (worker_down → restart/remesh, "
+                  "s)**", "", _md_table(sup["recovery_s"]), ""]
+    return "\n".join(L).rstrip() + "\n"
+
+
+def cost_model(a: dict) -> dict:
+    """The machine-readable tables a trace-replay simulator consumes:
+    just the fitted models + distributions, no file lists."""
+    out: dict = {"run": os.path.basename(a["run_dir"]),
+                 "degenerate": a["degenerate"]}
+    eng = a.get("engine")
+    if eng:
+        out["engine"] = {k: eng[k] for k in
+                         ("step_model", "step_ms", "decode_step_ms",
+                          "prefill_tokens_per_step", "n_live")}
+    tr = a.get("trace")
+    if tr:
+        out["phases"] = tr["phases"]
+        if "ttft_model" in tr:
+            out["ttft_model"] = tr["ttft_model"]
+    trn = a.get("train")
+    if trn:
+        out["train"] = {k: trn[k] for k in
+                        ("step_ms", "data_ms", "sync_ms", "ckpt_ms",
+                         "tokens_per_s")}
+    sup = a.get("supervisor")
+    if sup:
+        out["supervisor"] = {k: sup[k] for k in
+                             ("events", "recovery_s")}
+    return out
+
+
+def write_report(run_dir: str, out_dir: Optional[str] = None) -> dict:
+    """Analyze run_dir and write `report.md` + `cost_model.json` into
+    out_dir (default: the run dir itself). Returns the analysis plus
+    the artifact paths."""
+    a = analyze(run_dir)
+    out_dir = out_dir or run_dir
+    os.makedirs(out_dir, exist_ok=True)
+    report_md = os.path.join(out_dir, "report.md")
+    cost_json = os.path.join(out_dir, "cost_model.json")
+    with open(report_md, "w", encoding="utf-8") as f:
+        f.write(_render_md(a))
+    with open(cost_json, "w", encoding="utf-8") as f:
+        json.dump(cost_model(a), f, indent=2, sort_keys=True)
+        f.write("\n")
+    a["report_md"] = report_md
+    a["cost_model_json"] = cost_json
+    return a

@@ -1,0 +1,190 @@
+"""Declarative SLOs with multi-window burn rates over federated metrics.
+
+The targets mirror the serving SLOs the router already measures at the
+client edge (PERF.md rounds 10/13): **TTFT p99** and **ITL p99** as
+latency objectives, and **availability** = completed / (completed +
+shed + failed) as a request-success objective. Each target carries an
+objective fraction (e.g. 0.99 => a 1% error budget); the tracker turns
+cumulative good/total counts into
+
+* **burn rate** per window (Google SRE multi-window convention): the
+  bad fraction observed over the window divided by the budget fraction
+  — 1.0 means the budget is being consumed exactly at the rate that
+  exhausts it by the end of the SLO period, >>1 pages someone;
+* **error budget remaining** since process start: 1 minus the consumed
+  fraction of the budget (can go negative when the budget is blown —
+  the fault-injection harness asserts a mid-stream kill burns budget
+  without exhausting it).
+
+Counting good latency events uses cumulative histogram buckets
+(`Histogram.count_le`), which is exact when the target is a bucket
+edge — the default targets (0.5 s TTFT, 0.05 s ITL) are edges of
+LATENCY_BUCKETS for precisely this reason.
+
+Stdlib-only and clock-injectable: callers pass `now_fn` (default
+`time.monotonic`) so tests drive time deterministically.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Callable, Iterable, Optional
+
+from ..config import knob
+
+#: ring snapshots older than the longest window by this factor are
+#: pruned (one extra entry is kept past the edge as the diff baseline).
+_PRUNE_SLACK = 1.25
+
+
+@dataclass(frozen=True)
+class SLOTarget:
+    """One declarative objective.
+
+    kind="latency": good = observations <= threshold_s, total = all
+    observations of the histogram. kind="availability": good =
+    completed, total = completed + shed + failed.
+    """
+    name: str
+    kind: str                           # "latency" | "availability"
+    objective: float                    # e.g. 0.99 => 1% error budget
+    threshold_s: Optional[float] = None
+
+    @property
+    def budget_fraction(self) -> float:
+        return max(1e-9, 1.0 - self.objective)
+
+
+def default_targets() -> list[SLOTarget]:
+    """The stock serving SLOs, thresholds/objectives from the knob
+    registry (SLO_TTFT_P99_S / SLO_ITL_P99_S / SLO_AVAILABILITY)."""
+    return [
+        SLOTarget("ttft_p99", "latency", objective=0.99,
+                  threshold_s=knob("SLO_TTFT_P99_S")),
+        SLOTarget("itl_p99", "latency", objective=0.99,
+                  threshold_s=knob("SLO_ITL_P99_S")),
+        SLOTarget("availability", "availability",
+                  objective=knob("SLO_AVAILABILITY")),
+    ]
+
+
+class SLOTracker:
+    """Turns cumulative (good, total) counts into burn-rate and
+    error-budget gauges.
+
+    `update()` is fed monotonically non-decreasing cumulative counts
+    (straight from counters/histograms — no deltas); the tracker keeps
+    a time-stamped ring and diffs the newest entry against the oldest
+    entry inside each window, so a burn rate is "bad fraction over the
+    last W seconds / budget fraction".
+    """
+
+    def __init__(self, targets: Optional[Iterable[SLOTarget]] = None,
+                 windows_s: Optional[Iterable[float]] = None,
+                 now_fn: Callable[[], float] = time.monotonic):
+        self.targets = {t.name: t for t in
+                        (targets if targets is not None
+                         else default_targets())}
+        self.windows_s = tuple(windows_s if windows_s is not None
+                               else knob("SLO_WINDOWS_S"))
+        self._now = now_fn
+        # ring of (t, {name: (good, total)}) cumulative snapshots
+        self._ring: list[tuple[float, dict[str, tuple[int, int]]]] = []
+
+    # ------------------------------------------------------------------
+    def update(self, counts: dict[str, tuple[int, int]]) -> None:
+        """Append one cumulative snapshot: name -> (good, total)."""
+        t = self._now()
+        self._ring.append(
+            (t, {k: (int(g), int(n)) for k, (g, n) in counts.items()}))
+        horizon = t - max(self.windows_s) * _PRUNE_SLACK
+        # keep at least one entry older than the longest window so the
+        # window diff always has a baseline
+        while len(self._ring) > 2 and self._ring[1][0] < horizon:
+            self._ring.pop(0)
+
+    def _window_delta(self, name: str,
+                      window_s: float) -> tuple[int, int]:
+        """(Δbad, Δtotal) between the newest snapshot and the oldest one
+        inside the window (or the last one just outside it)."""
+        if len(self._ring) < 2:
+            return 0, 0
+        t_new, newest = self._ring[-1]
+        if name not in newest:
+            return 0, 0
+        base = None
+        for t, snap in self._ring[:-1]:
+            if name not in snap:
+                continue
+            if t >= t_new - window_s:
+                base = snap[name]
+                break
+            base = snap[name]          # best older baseline so far
+        if base is None:
+            return 0, 0
+        g1, n1 = newest[name]
+        g0, n0 = base
+        d_total = max(0, n1 - n0)
+        d_bad = max(0, (n1 - g1) - (n0 - g0))
+        return d_bad, d_total
+
+    # ------------------------------------------------------------------
+    def burn_rate(self, name: str, window_s: float) -> float:
+        """Bad fraction over the window / budget fraction (0 when the
+        window saw no events)."""
+        target = self.targets[name]
+        d_bad, d_total = self._window_delta(name, window_s)
+        if d_total <= 0:
+            return 0.0
+        return (d_bad / d_total) / target.budget_fraction
+
+    def budget_remaining(self, name: str) -> float:
+        """1 - consumed fraction of the budget since process start
+        (cumulative counters start at zero, so no baseline snapshot is
+        needed); 1.0 before any events, negative once exhausted."""
+        target = self.targets[name]
+        if not self._ring:
+            return 1.0
+        good, total = self._ring[-1][1].get(name, (0, 0))
+        if total <= 0:
+            return 1.0
+        bad_frac = (total - good) / total
+        return 1.0 - bad_frac / target.budget_fraction
+
+    # ------------------------------------------------------------------
+    def snapshot(self) -> dict:
+        out: dict = {}
+        for name, target in sorted(self.targets.items()):
+            good, total = ((0, 0) if not self._ring
+                           else self._ring[-1][1].get(name, (0, 0)))
+            out[name] = {
+                "kind": target.kind,
+                "objective": target.objective,
+                "threshold_s": target.threshold_s,
+                "good": good, "total": total,
+                "budget_remaining": round(self.budget_remaining(name), 6),
+                "burn_rate": {str(int(w)):
+                              round(self.burn_rate(name, w), 6)
+                              for w in self.windows_s},
+            }
+        return out
+
+    def render_prometheus(self) -> list[str]:
+        """Gauge lines appended to the router's /metrics page."""
+        lines = ["# HELP slo_burn_rate error-budget burn rate per window "
+                 "(1.0 = consuming exactly the budget)",
+                 "# TYPE slo_burn_rate gauge"]
+        for name in sorted(self.targets):
+            for w in self.windows_s:
+                lines.append(
+                    f'slo_burn_rate{{slo="{name}",window_s="{int(w)}"}} '
+                    f"{self.burn_rate(name, w):.6f}")
+        lines += ["# HELP slo_error_budget_remaining fraction of the "
+                  "error budget left since start (negative = exhausted)",
+                  "# TYPE slo_error_budget_remaining gauge"]
+        for name in sorted(self.targets):
+            lines.append(
+                f'slo_error_budget_remaining{{slo="{name}"}} '
+                f"{self.budget_remaining(name):.6f}")
+        return lines

@@ -33,11 +33,15 @@ def load_for_inference(ckpt: str, *, shard: bool = False, log=print):
     """Restore a trainer checkpoint for decoding; shared by this CLI and
     the serving front-end (`python -m distributed_pytorch_tpu.serve`).
 
-    Returns `(model, variables, model_cfg, train_cfg, mesh, step)` —
-    `mesh` is None unless `shard` asked for (and the device count allows)
-    a sharded restore in the checkpoint's training-recipe layout. pp
-    checkpoints are unstacked into the loop model (pipeline doesn't
-    support KV caches); optimizer moments are never materialized."""
+    Returns `(model, variables, model_cfg, train_cfg, mesh, step,
+    weights_version)` — `mesh` is None unless `shard` asked for (and the
+    device count allows) a sharded restore in the checkpoint's
+    training-recipe layout; `weights_version` is the step dir's identity
+    (`step_N-<manifest digest prefix>`, checkpoint.weights_version; None
+    for manifest-less dirs) that the serving front-end surfaces on
+    /metrics and every completion payload. pp checkpoints are unstacked
+    into the loop model (pipeline doesn't support KV caches); optimizer
+    moments are never materialized."""
     from distributed_pytorch_tpu.train import checkpoint as ckpt_mod
     from distributed_pytorch_tpu.train.state import (build_model,
                                                      init_train_state,
@@ -49,8 +53,10 @@ def load_for_inference(ckpt: str, *, shard: bool = False, log=print):
         assert last is not None, f"no checkpoint found under {path}"
         path = last
     model_cfg, train_cfg, step = ckpt_mod.load_configs(path)
+    weights_version = ckpt_mod.weights_version(path)
     log(f"loaded config from {path} (step {step}): "
-        f"{model_cfg.n_layer}L/{model_cfg.n_embd}d {model_cfg.attn}")
+        f"{model_cfg.n_layer}L/{model_cfg.n_embd}d {model_cfg.attn}"
+        + (f" [{weights_version}]" if weights_version else ""))
 
     # Shapes only (jax.eval_shape): no concrete init of params or AdamW
     # moments just to learn the checkpoint's structure; restore skips the
@@ -107,7 +113,8 @@ def load_for_inference(ckpt: str, *, shard: bool = False, log=print):
     variables = {"params": params}
     if state.moe_state:
         variables["moe_state"] = state.moe_state
-    return model, variables, model_cfg, train_cfg, mesh, step
+    return (model, variables, model_cfg, train_cfg, mesh, step,
+            weights_version)
 
 
 def main(argv=None) -> None:
@@ -149,7 +156,7 @@ def main(argv=None) -> None:
 
     from distributed_pytorch_tpu.models.generate import make_generate_fn
 
-    model, variables, model_cfg, train_cfg, mesh, _ = load_for_inference(
+    model, variables, model_cfg, train_cfg, mesh, _, _ = load_for_inference(
         args.ckpt, shard=args.shard)
 
     enc = _encoder()

@@ -117,12 +117,13 @@ async def _amain(args) -> None:
     if args.demo:
         model, variables, mesh, recipe = _demo_model()
         encoder = None
+        weights_version = "demo"
         print("demo mode: tiny random-init model, token-id prompts only")
     else:
         from distributed_pytorch_tpu.sample import _encoder, \
             load_for_inference
-        model, variables, _, train_cfg, mesh, _ = load_for_inference(
-            args.ckpt, shard=args.shard)
+        (model, variables, _, train_cfg, mesh, _,
+         weights_version) = load_for_inference(args.ckpt, shard=args.shard)
         recipe = train_cfg.parallelism if mesh is not None else "single"
         encoder = _encoder()
 
@@ -143,6 +144,10 @@ async def _amain(args) -> None:
     sched.metrics.set_build_info(
         preset="demo" if args.demo else (args.ckpt or ""),
         trace=args.trace)
+    # weights identity (ckpt step dir + manifest digest prefix, or
+    # "demo"): an info gauge on /metrics and a field on every
+    # completion payload — the live-weight-delivery seed
+    sched.metrics.set_weights_version(weights_version)
     app = ServeApp(sched, host=args.host, port=args.port, encoder=encoder,
                    default_max_tokens=args.max_tokens_default,
                    request_timeout_s=args.request_timeout_s,

@@ -72,15 +72,23 @@ def engine_build_info(engine) -> dict:
     return info
 
 
+def _esc(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+
+def _labels(labels: dict) -> str:
+    """Render a label dict as `{k="v",...}` (empty dict -> "")."""
+    if not labels:
+        return ""
+    return "{" + ",".join(f'{k}="{_esc(v)}"'
+                          for k, v in labels.items()) + "}"
+
+
 def _render_info(name: str, help_: str, info: dict) -> list[str]:
     """Prometheus info-gauge idiom: constant 1 with the facts as labels."""
     if not info:
         return []
-
-    def esc(v) -> str:
-        return str(v).replace("\\", "\\\\").replace('"', '\\"')
-
-    labels = ",".join(f'{k}="{esc(v)}"' for k, v in sorted(info.items()))
+    labels = ",".join(f'{k}="{_esc(v)}"' for k, v in sorted(info.items()))
     return [f"# HELP {name} {help_}", f"# TYPE {name} gauge",
             f"{name}{{{labels}}} 1"]
 
@@ -123,6 +131,54 @@ class Histogram:
     def max(self) -> Optional[float]:
         return max(self._samples) if self._samples else None
 
+    def count_le(self, threshold: float) -> int:
+        """Observations provably <= threshold from the bucket counts
+        alone (cumulative count of every bucket whose edge fits). Exact
+        when the threshold is a bucket edge — SLO targets default to
+        edges of LATENCY_BUCKETS for exactly this reason — and a
+        conservative undercount otherwise."""
+        total = 0
+        for edge, c in zip(self.buckets, self.counts):
+            if edge <= threshold:
+                total += c
+            else:
+                break
+        return total
+
+    def to_dict(self) -> dict:
+        """JSON-serializable snapshot carrying everything `merge_from`
+        needs: per-bucket (non-cumulative) counts merge by elementwise
+        addition, reservoirs by concatenate-and-cap."""
+        return {"name": self.name, "help": self.help,
+                "buckets": list(self.buckets),
+                "counts": list(self.counts),
+                "sum": self.sum, "count": self.count,
+                "samples": list(self._samples)}
+
+    def merge_from(self, snap: dict) -> None:
+        """Fold another process's `to_dict()` snapshot into this
+        histogram. Bucket grids must match exactly — merging histograms
+        with different edges would silently misbucket, so it raises."""
+        if tuple(snap["buckets"]) != self.buckets:
+            raise ValueError(
+                f"{self.name}: bucket mismatch "
+                f"({snap['buckets']!r} != {list(self.buckets)!r})")
+        for i, c in enumerate(snap["counts"]):
+            self.counts[i] += int(c)
+        self.sum += float(snap["sum"])
+        self.count += int(snap["count"])
+        room = self._max_samples - len(self._samples)
+        if room > 0:
+            self._samples.extend(snap["samples"][:room])
+
+    @classmethod
+    def from_dict(cls, snap: dict,
+                  max_samples: int = 65536) -> "Histogram":
+        h = cls(snap["name"], snap.get("help", ""),
+                buckets=snap["buckets"], max_samples=max_samples)
+        h.merge_from(snap)
+        return h
+
     def render(self) -> list[str]:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} histogram"]
@@ -147,6 +203,119 @@ class Histogram:
                 f"mean_{unit}": round(self.sum / self.count * scale, 3)}
 
 
+def merge_histograms(snaps: list[dict], max_samples: int = 65536) -> dict:
+    """Merge N `Histogram.to_dict()` snapshots into one snapshot dict.
+    Bucket counts sum exactly (the fleet page is bit-equal to summing
+    per-replica scrapes); reservoirs concatenate capped at max_samples."""
+    if not snaps:
+        raise ValueError("no histogram snapshots to merge")
+    h = Histogram.from_dict(snaps[0], max_samples=max_samples)
+    for s in snaps[1:]:
+        h.merge_from(s)
+    return h.to_dict()
+
+
+def render_hist_snap(snap: dict, labels: Optional[dict] = None,
+                     header: bool = True) -> list[str]:
+    """Render a histogram snapshot dict as Prometheus text, optionally
+    tagging every series with extra labels (the fleet page's
+    `replica="host:port"`) and suppressing the HELP/TYPE header when the
+    metric name was already introduced by the fleet-summed series."""
+    name = snap["name"]
+    extra = dict(labels or {})
+    lines: list[str] = []
+    if header:
+        lines += [f"# HELP {name} {snap.get('help', '')}",
+                  f"# TYPE {name} histogram"]
+    cum = 0
+    for edge, c in zip(snap["buckets"], snap["counts"]):
+        cum += c
+        lines.append(f'{name}_bucket{_labels({**extra, "le": edge})} {cum}')
+    lines.append(
+        f'{name}_bucket{_labels({**extra, "le": "+Inf"})} {snap["count"]}')
+    lines.append(f'{name}_sum{_labels(extra)} {snap["sum"]}')
+    lines.append(f'{name}_count{_labels(extra)} {snap["count"]}')
+    return lines
+
+
+def render_fleet(snapshots: dict) -> str:
+    """The router's `GET /metrics/fleet` page: one Prometheus document
+    built from per-replica `ServeMetrics.snapshot()` dicts — each
+    histogram appears once fleet-summed (unlabeled, bit-equal to adding
+    the per-replica scrapes) and once per replica with a `replica`
+    label; counters likewise; gauges and provenance only per replica
+    (summing a queue depth across replicas is meaningful, summing a
+    build hash is not)."""
+    reps = sorted(snapshots.items())
+    lines = ["# HELP serve_fleet_replicas replicas contributing to this "
+             "fleet page",
+             "# TYPE serve_fleet_replicas gauge",
+             f"serve_fleet_replicas {len(reps)}"]
+    hist_names: list[str] = []
+    for _, snap in reps:
+        for hn in snap.get("histograms", {}):
+            if hn not in hist_names:
+                hist_names.append(hn)
+    for hn in hist_names:
+        per = [(r, snap["histograms"][hn]) for r, snap in reps
+               if hn in snap.get("histograms", {})]
+        lines += render_hist_snap(merge_histograms([s for _, s in per]),
+                                  header=True)
+        for r, s in per:
+            lines += render_hist_snap(s, labels={"replica": r},
+                                      header=False)
+    counter_keys: list[str] = []
+    for _, snap in reps:
+        for k in snap.get("counters", {}):
+            if k not in counter_keys:
+                counter_keys.append(k)
+    if counter_keys:
+        lines += ["# HELP serve_fleet_requests_total lifecycle counters "
+                  "summed across replicas (and per replica, labeled)",
+                  "# TYPE serve_fleet_requests_total counter"]
+        for k in counter_keys:
+            tot = sum(int(snap.get("counters", {}).get(k, 0))
+                      for _, snap in reps)
+            lines.append(
+                f'serve_fleet_requests_total{_labels({"event": k})} {tot}')
+            for r, snap in reps:
+                if k in snap.get("counters", {}):
+                    lines.append(
+                        "serve_fleet_requests_total"
+                        f'{_labels({"event": k, "replica": r})} '
+                        f'{snap["counters"][k]}')
+    occ_n = sum(int(s.get("occ_n", 0)) for _, s in reps)
+    occ_sum = sum(float(s.get("occ_sum", 0.0)) for _, s in reps)
+    lines += ["# HELP serve_fleet_slot_occupancy_mean mean live-slot "
+              "fraction over all fused steps, fleet-wide",
+              "# TYPE serve_fleet_slot_occupancy_mean gauge",
+              "serve_fleet_slot_occupancy_mean "
+              f"{(occ_sum / occ_n if occ_n else 0.0):.4f}"]
+    gauge_names: list[str] = []
+    for _, snap in reps:
+        for g in snap.get("gauges", {}):
+            if g not in gauge_names:
+                gauge_names.append(g)
+    for g in gauge_names:
+        lines.append(f"# TYPE {g} gauge")
+        for r, snap in reps:
+            if g in snap.get("gauges", {}):
+                v = snap["gauges"][g]
+                lines.append(f'{g}{_labels({"replica": r})} '
+                             f"{v if v is not None else 'NaN'}")
+    for r, snap in reps:
+        bi = snap.get("build_info") or {}
+        if bi:
+            labels = {**{k: str(v) for k, v in sorted(bi.items())},
+                      "replica": r}
+            lines.append(f"serve_build_info{_labels(labels)} 1")
+        wv = snap.get("weights_version")
+        if wv:
+            lines.append("serve_weights_version"
+                         f'{_labels({"replica": r, "version": wv})} 1')
+    return "\n".join(lines) + "\n"
+
+
 class ServeMetrics:
     """The scheduler/server's shared metrics registry."""
 
@@ -154,9 +323,12 @@ class ServeMetrics:
     #: 'preempted'/'requeued' track the paged pool's block-level
     #: preemption (every preempted request is requeued, never lost);
     #: 'prefix_hit_tokens'/'prefix_miss_tokens' split each admission's
-    #: prompt into reused-from-cached-blocks vs actually-prefilled tokens.
+    #: prompt into reused-from-cached-blocks vs actually-prefilled
+    #: tokens; 'failed' counts requests terminated by an engine error —
+    #: the denominator term of the availability SLO that neither
+    #: 'completed' nor 'shed' covers.
     COUNTERS = ("submitted", "admitted", "completed", "cancelled", "shed",
-                "tokens_out", "preempted", "requeued",
+                "failed", "tokens_out", "preempted", "requeued",
                 "prefix_hit_tokens", "prefix_miss_tokens")
 
     def __init__(self):
@@ -188,6 +360,7 @@ class ServeMetrics:
         self.shed_counts: dict[str, int] = {}     # cause -> n
         self.retire_counts: dict[str, int] = {}   # reason -> n
         self.build_info: dict[str, str] = {}      # provenance labels
+        self.weights_version: Optional[str] = None
         self._occ_sum = 0.0
         self._occ_n = 0
 
@@ -228,20 +401,57 @@ class ServeMetrics:
         whatever identifies THIS serving config in a scrape)."""
         self.build_info.update({k: str(v) for k, v in info.items()})
 
+    def set_weights_version(self, version: Optional[str]) -> None:
+        """Record which weights this replica serves (ckpt step dir +
+        manifest digest prefix, or 'demo') — surfaces as an info gauge
+        on /metrics and rides every completion payload."""
+        self.weights_version = version
+
     # ------------------------------------------------------------------
+    def _histograms(self) -> tuple:
+        return (self.ttft, self.itl, self.e2e, self.queue_wait,
+                self.prefill_tokens_per_step)
+
+    def snapshot(self) -> dict:
+        """JSON-serializable state for `GET /metrics.json` — everything
+        the router needs to rebuild this replica's series on the fleet
+        page and to merge histograms exactly (raw per-bucket counts, raw
+        occupancy accumulators, evaluated gauges)."""
+        gauges = {}
+        for name, (fn, _) in sorted(self._gauges.items()):
+            try:
+                gauges[name] = round(float(fn()), 6)
+            except Exception:  # pragma: no cover — gauge died
+                gauges[name] = None
+        return {"kind": "serve",
+                "histograms": {h.name: h.to_dict()
+                               for h in self._histograms()},
+                "counters": dict(self.counters),
+                "shed_by_cause": dict(self.shed_counts),
+                "retired_by_reason": dict(self.retire_counts),
+                "gauges": gauges,
+                "build_info": dict(self.build_info),
+                "weights_version": self.weights_version,
+                "occ_sum": self._occ_sum, "occ_n": self._occ_n,
+                "decode_stall_s": self.decode_stall_s}
+
     def render_prometheus(self) -> str:
         """The `/metrics` payload (Prometheus text exposition 0.0.4)."""
         lines: list[str] = _render_info(
             "serve_build_info",
             "serving config provenance (labels; value always 1)",
             self.build_info)
-        for h in (self.ttft, self.itl, self.e2e, self.queue_wait,
-                  self.prefill_tokens_per_step):
+        if self.weights_version:
+            lines += _render_info(
+                "serve_weights_version",
+                "checkpoint identity of the served weights",
+                {"version": self.weights_version})
+        for h in self._histograms():
             lines += h.render()
         lines += ["# HELP serve_requests_total request lifecycle counters",
                   "# TYPE serve_requests_total counter"]
         for name in ("submitted", "admitted", "completed", "cancelled",
-                     "shed", "preempted", "requeued"):
+                     "shed", "failed", "preempted", "requeued"):
             lines.append(f'serve_requests_total{{event="{name}"}} '
                          f'{self.counters[name]}')
         lines += ["# HELP serve_prefix_tokens_total prompt tokens served "
@@ -285,6 +495,8 @@ class ServeMetrics:
         out.update(self.counters)
         if self.build_info:
             out["build_info"] = dict(self.build_info)
+        if self.weights_version:
+            out["weights_version"] = self.weights_version
         if self.shed_counts:
             out["shed_by_cause"] = dict(self.shed_counts)
         if self.retire_counts:
@@ -349,6 +561,25 @@ class RouterMetrics:
     def set_build_info(self, **info) -> None:
         """Merge provenance labels into the router build-info gauge."""
         self.build_info.update({k: str(v) for k, v in info.items()})
+
+    def snapshot(self) -> dict:
+        """JSON-serializable state, shape-compatible with
+        `ServeMetrics.snapshot()` so the same merge/render helpers work
+        on router registries (federation tests, obs_report)."""
+        gauges = {}
+        for name, (fn, _) in sorted(self._gauges.items()):
+            try:
+                gauges[name] = round(float(fn()), 6)
+            except Exception:  # pragma: no cover — gauge died
+                gauges[name] = None
+        return {"kind": "router",
+                "histograms": {h.name: h.to_dict()
+                               for h in (self.ttft, self.itl, self.e2e)},
+                "counters": dict(self.counters),
+                "shed_by_cause": dict(self.shed_counts),
+                "dispatch_by_replica": dict(self.dispatch_counts),
+                "gauges": gauges,
+                "build_info": dict(self.build_info)}
 
     def render_prometheus(self) -> str:
         lines: list[str] = _render_info(

@@ -55,8 +55,11 @@ import json
 import time
 from typing import AsyncIterator, Optional
 
+from distributed_pytorch_tpu.config import knob
 from distributed_pytorch_tpu.obs import trace as obs_trace
-from distributed_pytorch_tpu.serve.metrics import RouterMetrics
+from distributed_pytorch_tpu.obs.slo import SLOTracker
+from distributed_pytorch_tpu.serve.metrics import (RouterMetrics,
+                                                   render_fleet)
 from distributed_pytorch_tpu.serve.scheduler import ShedError
 from distributed_pytorch_tpu.serve.server import (_json_response,
                                                   _response)
@@ -110,6 +113,8 @@ class Replica:
         self.live_slots = 0
         self.n_slots = 0
         self.last_err: Optional[str] = None
+        self.metrics_snapshot: Optional[dict] = None  # last /metrics.json
+        self.last_metrics_at = 0.0     # perf_counter of that pull
 
     @property
     def dispatchable(self) -> bool:
@@ -144,7 +149,9 @@ class Router:
                  backoff_base_s: float = 0.5, backoff_cap_s: float = 8.0,
                  retry_budget: int = 3, connect_timeout_s: float = 2.0,
                  stream_idle_timeout_s: Optional[float] = None,
-                 metrics: Optional[RouterMetrics] = None):
+                 metrics: Optional[RouterMetrics] = None,
+                 fleet_poll_interval_s: Optional[float] = None,
+                 slo: Optional[SLOTracker] = None):
         self.replicas: dict[str, Replica] = {}
         for addr in replicas:
             rep = Replica(addr)
@@ -169,6 +176,17 @@ class Router:
         self.metrics.set_build_info(replicas=len(self.replicas),
                                     retry_budget=retry_budget,
                                     probe_interval_s=probe_interval_s)
+        # federation: how often (at most) each healthy replica's
+        # /metrics.json is pulled — it rides the health-probe cadence,
+        # so the effective period is max(probe, fleet poll) intervals
+        self.fleet_poll_interval_s = (
+            fleet_poll_interval_s if fleet_poll_interval_s is not None
+            else knob("FLEET_POLL_INTERVAL_S"))
+        # SLO accounting at the client edge: latency objectives read the
+        # router's OWN ttft/itl histograms (a failover gap is visible
+        # only here — the replica never observes it), availability folds
+        # in the federated replica-side 'failed' counters
+        self.slo = slo if slo is not None else SLOTracker()
         self._probe_task: Optional[asyncio.Task] = None
         self._rr = 0                   # round-robin tiebreak cursor
 
@@ -233,6 +251,7 @@ class Router:
         reps = list(self.replicas.values())
         if reps:
             await asyncio.gather(*(self._probe_one(r) for r in reps))
+        self._update_slo()
 
     async def _probe_one(self, rep: Replica) -> None:
         now = time.perf_counter()
@@ -255,6 +274,21 @@ class Router:
             rep.fails = 0
             rep.down_streak = 0
             rep.last_err = None
+            # federation pull rides the probe cadence: fetch the
+            # replica's full metrics snapshot at most every
+            # fleet_poll_interval_s, best-effort (a slow/failed pull
+            # never affects health state — the probe already succeeded)
+            if now - rep.last_metrics_at >= self.fleet_poll_interval_s:
+                try:
+                    mstatus, snap = await self._http_json(
+                        rep, "GET", "/metrics.json",
+                        timeout=self.probe_timeout_s)
+                    if mstatus == 200 and snap:
+                        rep.metrics_snapshot = snap
+                        rep.last_metrics_at = now
+                except (ConnectionError, OSError, asyncio.TimeoutError,
+                        asyncio.IncompleteReadError, ValueError):
+                    pass
         elif body.get("draining"):
             # alive but refusing admission: gate out of dispatch without
             # the down-state backoff (a drain is deliberate, not a fault)
@@ -605,6 +639,54 @@ class Router:
         return line
 
     # ------------------------------------------------------------------
+    # federation / SLO
+    # ------------------------------------------------------------------
+
+    def fleet_snapshots(self) -> dict:
+        """Last pulled `/metrics.json` snapshot per replica (replicas
+        that never answered a pull are absent)."""
+        return {name: rep.metrics_snapshot
+                for name, rep in sorted(self.replicas.items())
+                if rep.metrics_snapshot is not None}
+
+    def render_fleet(self) -> str:
+        """The `/metrics/fleet` page: fleet-summed histograms/counters
+        plus per-replica labeled series (serve/metrics.render_fleet)."""
+        return render_fleet(self.fleet_snapshots())
+
+    def _slo_counts(self) -> dict:
+        """Cumulative (good, total) per SLO target. Latency objectives
+        count from the router's own histograms' buckets (exact when the
+        threshold is a bucket edge); availability folds the federated
+        replica-side 'failed' counters into the denominator."""
+        counts: dict = {}
+        fleet_failed = sum(
+            int(s.get("counters", {}).get("failed", 0))
+            for s in self.fleet_snapshots().values())
+        for name, target in self.slo.targets.items():
+            if target.kind == "latency":
+                h = self.metrics.ttft if "ttft" in name else self.metrics.itl
+                counts[name] = (h.count_le(target.threshold_s), h.count)
+            else:
+                completed = self.metrics.counters["completed"]
+                total = (completed + self.metrics.counters["shed"]
+                         + fleet_failed)
+                counts[name] = (completed, total)
+        return counts
+
+    def _update_slo(self) -> None:
+        try:
+            self.slo.update(self._slo_counts())
+        except Exception:              # pragma: no cover — accounting
+            pass                       # must never break the prober
+
+    def render_metrics(self) -> str:
+        """The router's /metrics page: its own registry plus the SLO
+        burn-rate / error-budget gauges."""
+        return (self.metrics.render_prometheus()
+                + "\n".join(self.slo.render_prometheus()) + "\n")
+
+    # ------------------------------------------------------------------
 
     def snapshot(self) -> dict:
         return {name: rep.snapshot()
@@ -618,7 +700,9 @@ class RouterApp:
     drives.
 
     Endpoints: POST /v1/completions (SSE or JSON), GET /healthz (200
-    while >= 1 replica is dispatchable), GET /metrics, GET
+    while >= 1 replica is dispatchable), GET /metrics (own registry +
+    SLO gauges), GET /metrics/fleet (fleet-summed + per-replica-labeled
+    series from the federation pull), GET /metrics.json, GET
     /admin/replicas, POST /admin/drain {"replica": addr}, POST
     /admin/add_replica {"url": addr}, POST /admin/remove_replica."""
 
@@ -696,9 +780,19 @@ class RouterApp:
                     {"ok": n_up > 0, "healthy_replicas": n_up,
                      "replicas": self.router.snapshot()}))
             elif method == "GET" and path == "/metrics":
-                body = self.router.metrics.render_prometheus().encode()
+                body = self.router.render_metrics().encode()
                 writer.write(_response(
                     200, body, "text/plain; version=0.0.4; charset=utf-8"))
+            elif method == "GET" and path == "/metrics/fleet":
+                # one page for the whole fleet: fleet-summed histograms
+                # (bit-equal to adding per-replica scrapes) + per-replica
+                # labeled series, from the federation pull's snapshots
+                body = self.router.render_fleet().encode()
+                writer.write(_response(
+                    200, body, "text/plain; version=0.0.4; charset=utf-8"))
+            elif method == "GET" and path == "/metrics.json":
+                writer.write(_json_response(
+                    200, self.router.metrics.snapshot()))
             elif method == "GET" and path == "/admin/replicas":
                 writer.write(_json_response(200, self.router.snapshot()))
             elif method == "GET" and path.startswith("/debug/trace/"):
@@ -709,7 +803,8 @@ class RouterApp:
                                                "/admin/add_replica",
                                                "/admin/remove_replica"):
                 await self._admin(reader, writer, headers, path)
-            elif path in ("/healthz", "/metrics", "/v1/completions",
+            elif path in ("/healthz", "/metrics", "/metrics/fleet",
+                          "/metrics.json", "/v1/completions",
                           "/admin/replicas", "/admin/drain",
                           "/admin/add_replica", "/admin/remove_replica") \
                     or path.startswith("/debug/trace/"):
@@ -914,6 +1009,10 @@ def build_args(argv=None):
                    help="max re-dispatches per request before an "
                         "explicit shed")
     p.add_argument("--max-tokens-default", type=int, default=64)
+    p.add_argument("--fleet-poll-interval-s", type=float, default=None,
+                   help="min seconds between /metrics.json federation "
+                        "pulls per replica (default: the "
+                        "FLEET_POLL_INTERVAL_S knob)")
     return p.parse_args(argv)
 
 
@@ -923,7 +1022,8 @@ async def _amain(args) -> None:
                     fail_threshold=args.fail_threshold,
                     backoff_base_s=args.backoff_base_s,
                     backoff_cap_s=args.backoff_cap_s,
-                    retry_budget=args.retry_budget)
+                    retry_budget=args.retry_budget,
+                    fleet_poll_interval_s=args.fleet_poll_interval_s)
     app = RouterApp(router, host=args.host, port=args.port,
                     default_max_tokens=args.max_tokens_default)
     await router.start()

@@ -697,6 +697,9 @@ class Scheduler:
             # shed immediately instead of queueing into a dead loop.
             self._failed = EngineError(exc)
             for handle in list(self._pending):
+                # neither completed nor shed: the availability SLO's
+                # third denominator term
+                self.metrics.inc("failed")
                 self.tracer.event("sched.engine_error",
                                   handle._req.trace_id, cat="sched",
                                   error=repr(exc)[:200])

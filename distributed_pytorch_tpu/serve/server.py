@@ -201,6 +201,12 @@ class ServeApp:
                 body = self.scheduler.metrics.render_prometheus().encode()
                 writer.write(_response(
                     200, body, "text/plain; version=0.0.4; charset=utf-8"))
+            elif method == "GET" and path == "/metrics.json":
+                # the federation surface: the router pulls this on the
+                # health-probe cadence to build /metrics/fleet — raw
+                # per-bucket counts so fleet sums stay bit-exact
+                writer.write(_json_response(
+                    200, self.scheduler.metrics.snapshot()))
             elif method == "GET" and path.startswith("/debug/trace/"):
                 writer.write(self._debug_trace(path, query))
             elif method == "GET" and path == "/debug/timeline":
@@ -215,9 +221,9 @@ class ServeApp:
                     "draining": True, "drained": self.scheduler.drained,
                     "live_slots": self.scheduler.engine.n_live,
                     "queue_depth": self.scheduler.queue_depth}))
-            elif path in ("/healthz", "/metrics", "/v1/completions",
-                          "/admin/drain", "/admin/profile",
-                          "/debug/timeline") \
+            elif path in ("/healthz", "/metrics", "/metrics.json",
+                          "/v1/completions", "/admin/drain",
+                          "/admin/profile", "/debug/timeline") \
                     or path.startswith("/debug/trace/"):
                 writer.write(_json_response(405, {"error": "method not "
                                                            "allowed"}))
@@ -399,6 +405,9 @@ class ServeApp:
                     "text": self._decode(ret.tokens[ret.prompt_len:]),
                     "reason": ret.reason, "n_prompt": ret.prompt_len,
                     "trace_id": trace_id}
+            wv = self.scheduler.metrics.weights_version
+            if wv:
+                body["weights_version"] = wv
             spans = self._close_http_span(trace_id, t_req,
                                           len(handle.tokens))
             if spans:
@@ -475,6 +484,9 @@ class ServeApp:
             done_ev = {"done": True, "reason": ret.reason,
                        "n_tokens": len(handle.tokens),
                        "trace_id": trace_id}
+            wv = self.scheduler.metrics.weights_version
+            if wv:
+                done_ev["weights_version"] = wv
             # the span summary rides the done event so the router (or any
             # client) gets the replica-side timeline without a second
             # round-trip — offsets are relative to request receipt

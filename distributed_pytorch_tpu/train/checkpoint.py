@@ -155,6 +155,22 @@ def verify_manifest(path: str, *, deep: bool = True) -> list[str]:
     return violations
 
 
+def weights_version(path: str) -> Optional[str]:
+    """Identity string for the weights under a step dir:
+    `<step_dir_basename>-<blake2b(manifest)[:8]>`. The manifest already
+    digests every payload file, so hashing the manifest bytes gives a
+    version that changes iff any weight byte changed — cheap enough to
+    compute at load time. None when the dir has no manifest (demo /
+    pre-manifest checkpoints)."""
+    path = _abs(path)
+    try:
+        with open(os.path.join(path, MANIFEST), "rb") as f:
+            digest = hashlib.blake2b(f.read(), digest_size=16).hexdigest()
+    except OSError:
+        return None
+    return f"{os.path.basename(os.path.normpath(path))}-{digest[:8]}"
+
+
 def save_checkpoint(path: str, state: TrainState,
                     model_cfg: Optional[LLMConfig] = None,
                     train_cfg: Optional[TrainConfig] = None) -> str:

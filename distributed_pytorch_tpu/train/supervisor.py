@@ -64,6 +64,10 @@ from typing import Callable, Optional, Sequence
 
 from distributed_pytorch_tpu import config as cfg_mod
 from distributed_pytorch_tpu.obs.flight import FlightRecorder
+# jax-free by design, like this module (stdlib http + serve/metrics text
+# rendering) — safe to import into the watch loop
+from distributed_pytorch_tpu.train.telemetry import (SupervisorMetrics,
+                                                     TelemetryServer)
 
 STATE_FILE = "supervisor_state.json"
 TIMELINE_FILE = "supervisor_timeline.jsonl"
@@ -184,6 +188,8 @@ class SupervisorConfig:
     remesh_deadline_s: float = 5.0
     cpu_devices: int = 0           # per-worker virtual CPU devices
     hb_interval_s: float = 0.5
+    metrics_port: int = -1         # opt-in TelemetryServer: -1 off,
+    #                                0 ephemeral, >0 fixed
 
 
 @dataclasses.dataclass
@@ -219,6 +225,24 @@ class Supervisor:
         self.n_hosts = cfg.hosts
         self.restarts = 0
         self._stop = False
+        self._slots: list[_Slot] = []   # current gang (heartbeat gauges)
+        self.metrics = SupervisorMetrics()
+        self.metrics.set_build_info(run=cfg.run_name, hosts=cfg.hosts)
+        self.metrics.register_gauge(
+            "supervisor_generation", lambda: float(self.generation),
+            "gang incarnation counter (1 = first spawn)")
+        self.metrics.register_gauge(
+            "supervisor_n_hosts", lambda: float(self.n_hosts),
+            "live gang size (drops on re-mesh)")
+        self.metrics.register_gauge(
+            "supervisor_restarts", lambda: float(self.restarts),
+            "restarts consumed on the current topology")
+        self.metrics.register_gauge(
+            "supervisor_last_verified_ckpt_step",
+            self._last_verified_step_num,
+            "newest step with an intact manifest (-1: none yet)")
+        self.metrics.set_heartbeat_ages_fn(self._hb_ages)
+        self._telemetry: Optional[TelemetryServer] = None
         os.makedirs(self.run_dir, exist_ok=True)
 
     # ---- helpers --------------------------------------------------------
@@ -232,7 +256,26 @@ class Supervisor:
                 "distributed_pytorch_tpu.train.supervisor",
                 "--worker", "--", *argv]
 
+    def _last_verified_step_num(self) -> float:
+        path = _latest_verified_step(self.ckpt_root)
+        if path is None:
+            return -1.0
+        return float(os.path.basename(path)[5:])   # "step_N"
+
+    def _hb_ages(self) -> dict:
+        """slot -> seconds since its heartbeat file's last write (from
+        spawn when no beat has landed yet) — the SupervisorMetrics
+        heartbeat gauge source."""
+        ages = {}
+        for s in self._slots:
+            try:
+                ages[s.slot] = time.time() - os.path.getmtime(s.hb_path)
+            except OSError:
+                ages[s.slot] = time.monotonic() - s.spawned
+        return ages
+
     def _event(self, event: str, **fields) -> None:
+        self.metrics.event(event)
         self.flight.record(event=event, **fields)
         self.flight.dump_jsonl(os.path.join(self.run_dir, TIMELINE_FILE))
         kv = " ".join(f"{k}={v}" for k, v in fields.items())
@@ -288,6 +331,7 @@ class Supervisor:
                     stdout=logf, stderr=subprocess.STDOUT)
             slots.append(_Slot(slot=i, proc=proc, hb_path=hb,
                                spawned=time.monotonic()))
+        self._slots = slots
         self._event("gang_spawn", generation=self.generation, n_hosts=n,
                     resume=resume,
                     os_pids=[s.proc.pid for s in slots])
@@ -337,6 +381,13 @@ class Supervisor:
 
     # ---- main loop ------------------------------------------------------
 
+    def _status(self) -> dict:
+        return {"ok": True, "run": self.cfg.run_name,
+                "generation": self.generation, "n_hosts": self.n_hosts,
+                "restarts": self.restarts,
+                "workers_alive": sum(1 for s in self._slots
+                                     if s.proc.poll() is None)}
+
     def run(self) -> int:
         """Drive gangs to completion; returns an EXIT_* code."""
         prevs: list[tuple[int, object]] = []
@@ -348,9 +399,23 @@ class Supervisor:
                     prevs.append((signum, signal.signal(signum, _sig)))
                 except ValueError:  # pragma: no cover
                     pass
+        if self.cfg.metrics_port >= 0:
+            # duck-typed telemetry: the server only touches .metrics
+            # (render_prometheus/snapshot) and .flight
+            class _Tel:
+                metrics = self.metrics
+                flight = self.flight
+            self._telemetry = TelemetryServer(
+                _Tel(), port=self.cfg.metrics_port,
+                status_fn=self._status).start()
+            self.log(f"[supervisor] telemetry on "
+                     f"http://127.0.0.1:{self._telemetry.port}/metrics")
         try:
             return self._run()
         finally:
+            if self._telemetry is not None:
+                self._telemetry.stop()
+                self._telemetry = None
             for signum, prev in prevs:
                 if prev is not None:
                     signal.signal(signum, prev)
@@ -474,6 +539,10 @@ def cli(argv: Optional[Sequence[str]] = None) -> int:
     p.add_argument("--remesh-deadline-s", type=float, default=5.0)
     p.add_argument("--cpu-devices", type=int, default=0,
                    help="virtual CPU devices per worker (CPU smoke runs)")
+    p.add_argument("--metrics-port", type=int, default=-1,
+                   help="opt-in telemetry HTTP port (gang state, event "
+                        "counters, heartbeat ages, last verified ckpt "
+                        "step); -1 off, 0 ephemeral")
     args = p.parse_args(sup_argv)
 
     cfg = SupervisorConfig(
@@ -489,6 +558,7 @@ def cli(argv: Optional[Sequence[str]] = None) -> int:
         backoff_cap_s=args.backoff_cap_s,
         remesh_deadline_s=args.remesh_deadline_s,
         cpu_devices=args.cpu_devices,
+        metrics_port=args.metrics_port,
     )
     return Supervisor(cfg).run()
 

@@ -140,6 +140,115 @@ class TrainMetrics:
                 lines.append(f"{name} NaN")
         return "\n".join(lines) + "\n"
 
+    def snapshot(self) -> dict:
+        """JSON-serializable state for `/metrics.json`, shape-compatible
+        with ServeMetrics.snapshot() so the same federation merge/render
+        helpers apply (serve/metrics.py)."""
+        gauges = {}
+        for name, (fn, _) in sorted(self._gauges.items()):
+            try:
+                gauges[name] = round(float(fn()), 6)
+            except Exception:  # pragma: no cover — gauge died mid-run
+                gauges[name] = None
+        with self._lock:
+            return {"kind": "train",
+                    "histograms": {h.name: h.to_dict() for h in
+                                   (self.step_s, self.data_s,
+                                    self.sync_s, self.ckpt_s)},
+                    "counters": dict(self.counters),
+                    "anomaly_by_kind": dict(self.anomaly_counts),
+                    "gauges": gauges,
+                    "build_info": dict(self.build_info)}
+
+
+class SupervisorMetrics:
+    """Registry for the elastic-training supervisor (train/supervisor.py,
+    ISSUE 14): gang lifecycle event counters, generation / live-host /
+    restart gauges, per-worker heartbeat ages, and the last verified
+    checkpoint step — the live pane the gang previously lacked. Locked
+    like TrainMetrics (the supervisor's watch loop writes while the
+    TelemetryServer thread renders); jax-free, like the supervisor."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.event_counts: dict[str, int] = {}        # timeline events
+        self.build_info: dict[str, str] = {}
+        self._gauges: dict[str, tuple[Callable[[], float], str]] = {}
+        # slot -> heartbeat age in seconds, evaluated per render (the
+        # supervisor installs a reader over its hb files)
+        self._hb_ages_fn: Optional[Callable[[], dict]] = None
+
+    def event(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.event_counts[name] = self.event_counts.get(name, 0) + n
+
+    def register_gauge(self, name: str, fn: Callable[[], float],
+                       help_: str = "") -> None:
+        self._gauges[name] = (fn, help_)
+
+    def set_build_info(self, **info) -> None:
+        self.build_info.update({k: str(v) for k, v in info.items()})
+
+    def set_heartbeat_ages_fn(self, fn: Callable[[], dict]) -> None:
+        self._hb_ages_fn = fn
+
+    def _hb_ages(self) -> dict:
+        if self._hb_ages_fn is None:
+            return {}
+        try:
+            return {str(k): round(float(v), 3)
+                    for k, v in self._hb_ages_fn().items()}
+        except Exception:  # pragma: no cover — hb files mid-rotation
+            return {}
+
+    def render_prometheus(self) -> str:
+        lines: list[str] = _render_info(
+            "supervisor_build_info",
+            "supervisor run provenance (labels; value always 1)",
+            self.build_info)
+        with self._lock:
+            lines += ["# HELP supervisor_events_total gang lifecycle "
+                      "events (timeline event names)",
+                      "# TYPE supervisor_events_total counter"]
+            for name, n in sorted(self.event_counts.items()):
+                lines.append(
+                    f'supervisor_events_total{{event="{name}"}} {n}')
+        ages = self._hb_ages()
+        if ages:
+            lines += ["# HELP supervisor_heartbeat_age_seconds seconds "
+                      "since each worker's last heartbeat write",
+                      "# TYPE supervisor_heartbeat_age_seconds gauge"]
+            for slot, age in sorted(ages.items()):
+                lines.append(
+                    f'supervisor_heartbeat_age_seconds{{slot="{slot}"}} '
+                    f"{age}")
+        for name, (fn, help_) in sorted(self._gauges.items()):
+            if help_:
+                lines.append(f"# HELP {name} {help_}")
+            lines.append(f"# TYPE {name} gauge")
+            try:
+                lines.append(f"{name} {float(fn())}")
+            except Exception:  # pragma: no cover — gauge died mid-run
+                lines.append(f"{name} NaN")
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict:
+        """Shape-compatible with the other registries' snapshots (no
+        histograms — the supervisor's distributions live in its timeline
+        and come out of obs/replay.py instead)."""
+        gauges = {}
+        for name, (fn, _) in sorted(self._gauges.items()):
+            try:
+                gauges[name] = round(float(fn()), 6)
+            except Exception:  # pragma: no cover — gauge died mid-run
+                gauges[name] = None
+        with self._lock:
+            counters = dict(self.event_counts)
+        return {"kind": "supervisor", "histograms": {},
+                "counters": counters, "gauges": gauges,
+                "heartbeat_age_s": self._hb_ages(),
+                "build_info": dict(self.build_info)}
+
 
 class AnomalyMonitor:
     """Host-side loss/grad anomaly detection, fed at sync boundaries.
@@ -272,8 +381,14 @@ class TelemetryServer:
 
     Routes (mirroring the replica server's observability plane):
     * `GET /metrics`        — Prometheus text (TrainMetrics)
+    * `GET /metrics.json`   — the registry's federation snapshot (when
+      the registry implements `snapshot()` — all of them do)
     * `GET /debug/timeline` — the flight ring's last `?n=` records
     * `GET /healthz`        — `TrainTelemetry.status()` JSON
+
+    `telemetry` is duck-typed: anything with `.metrics` (a registry with
+    `render_prometheus()`) and `.flight` (a FlightRecorder) works — the
+    supervisor passes its own SupervisorMetrics/flight pair.
 
     Runs daemonized so a wedged scrape can never hold the process at
     exit; port 0 binds an ephemeral port (tests), the bound port is in
@@ -305,6 +420,13 @@ class TelemetryServer:
                     self._send(200,
                                tel.metrics.render_prometheus().encode(),
                                "text/plain; version=0.0.4; charset=utf-8")
+                elif path == "/metrics.json":
+                    snap = getattr(tel.metrics, "snapshot", None)
+                    if snap is None:
+                        self._send(404, b'{"error": "registry has no '
+                                        b'snapshot"}')
+                        return
+                    self._send(200, json.dumps(snap()).encode())
                 elif path == "/debug/timeline":
                     try:
                         n = max(1, int(query.get("n", "512")))

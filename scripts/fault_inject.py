@@ -282,7 +282,8 @@ async def _run_leg(args, n_replicas: int, inject: bool, log_dir: str,
         router = Router([r.addr for r in reps],
                         retry_budget=args.retry_budget,
                         probe_interval_s=0.2, fail_threshold=2,
-                        backoff_base_s=0.25, backoff_cap_s=2.0)
+                        backoff_base_s=0.25, backoff_cap_s=2.0,
+                        fleet_poll_interval_s=0.2)
         await router.start()
 
         npr, reqs = _workload(args)
@@ -335,6 +336,10 @@ async def _run_leg(args, n_replicas: int, inject: bool, log_dir: str,
             await fault_task
         snapshot = router.snapshot()
         metrics = router.metrics.summary()
+        router._update_slo()   # fold the drive's final counts in before
+        # reading the gauges (the probe loop stops with the router)
+        slo = router.slo.snapshot()
+        fleet_replicas = len(router.fleet_snapshots())
         await router.stop()
         # persist each live replica's step timeline before teardown —
         # the flight-recorder view of the drive (and, on the restarted
@@ -370,6 +375,8 @@ async def _run_leg(args, n_replicas: int, inject: bool, log_dir: str,
             "ttft_p99_ms": metrics["ttft"].get("p99_ms"),
             "itl_p50_ms": metrics["itl"].get("p50_ms"),
             "itl_p99_ms": metrics["itl"].get("p99_ms"),
+            "slo": slo,
+            "fleet_metrics_replicas": fleet_replicas,
             "artifacts": artifacts,
             "replica_states": snapshot}
 
@@ -393,6 +400,19 @@ async def _amain(args) -> dict:
     # lossless (no shed at all — admission moved, nothing dropped)
     out["ok"] = (out["failed"] == 0 and out["parity_mismatches"] == 0
                  and (args.mode != "drain" or out["shed"] == 0))
+    # SLO criterion (kill only): the mid-stream kill must BURN latency
+    # budget — the failover gap is a client-visible >threshold sample —
+    # without EXHAUSTING the availability budget (every request still
+    # completed or was explicitly shed)
+    if args.mode == "kill":
+        slo = out.get("slo", {})
+        out["slo_latency_burned"] = any(
+            max(slo.get(n, {}).get("burn_rate", {"0": 0.0}).values()) > 0
+            for n in ("ttft_p99", "itl_p99"))
+        out["slo_availability_budget_remaining"] = slo.get(
+            "availability", {}).get("budget_remaining", 1.0)
+        out["ok"] = (out["ok"] and out["slo_latency_burned"]
+                     and out["slo_availability_budget_remaining"] > 0)
     # the router runs IN this process: its dispatch/failover spans (one
     # trace per request, failed-over streams stitched) dump here too
     try:
@@ -401,6 +421,15 @@ async def _amain(args) -> dict:
         if len(rec):
             out.setdefault("artifacts", {})["router_trace"] = \
                 rec.dump_jsonl(os.path.join(log_dir, "router_trace.jsonl"))
+    except Exception:  # noqa: BLE001 — artifacts never fail the harness
+        pass
+    # replay the drive's artifacts (replica timelines + router trace)
+    # into the per-phase report + fitted cost model
+    try:
+        from distributed_pytorch_tpu.obs import replay
+        rep = replay.write_report(log_dir)
+        out.setdefault("artifacts", {})["report_md"] = rep["report_md"]
+        out["artifacts"]["cost_model_json"] = rep["cost_model_json"]
     except Exception:  # noqa: BLE001 — artifacts never fail the harness
         pass
     # the ~linear-scaling criterion needs a core per replica process +

@@ -169,6 +169,20 @@ def _run_leg(args, workdir: str, run_name: str, hosts: int,
     }
 
 
+def _replay_report(run_dir: str) -> dict:
+    """Deterministic timeline replay (obs/replay.py) over one leg's run
+    dir — per-phase distributions + fitted cost model next to the
+    timelines it came from. Best-effort: artifacts never fail the
+    harness."""
+    try:
+        from distributed_pytorch_tpu.obs import replay
+        rep = replay.write_report(run_dir)
+        return {"report_md": rep["report_md"],
+                "cost_model_json": rep["cost_model_json"]}
+    except Exception:  # noqa: BLE001
+        return {}
+
+
 def main(argv=None) -> int:
     args = build_args(argv)
     workdir = args.log_dir or os.path.join(
@@ -186,12 +200,17 @@ def main(argv=None) -> int:
            "baseline_iters": len(base_losses),
            "log_dir": workdir}
 
+    out["baseline_report"] = _replay_report(
+        os.path.join(workdir, "runs", "baseline"))
+
     if args.mode == "none":
         out["run_completed"] = base["rc"] == 0
         out["ok"] = out["run_completed"] and len(base_losses) > 0
     else:
         leg = _run_leg(args, workdir, "faulted", args.hosts,
                        inject=args.mode)
+        out["faulted_report"] = _replay_report(
+            os.path.join(workdir, "runs", "faulted"))
         losses = (leg["stats"] or {}).get("train_losses") or []
         state = leg["state"] or {}
         events = {e.get("event") for e in leg["timeline"]}

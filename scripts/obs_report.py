@@ -1,0 +1,65 @@
+#!/usr/bin/env python
+"""Replay a run dir's timelines into a cost-model report.
+
+    python scripts/obs_report.py runs/<run> [--out DIR] [--json]
+
+Loads every `*.jsonl` under the run dir (engine `timeline.jsonl`,
+request `trace.jsonl`, `train_timeline.jsonl`,
+`supervisor_timeline.jsonl` — classified by record shape, so
+fault-inject log dirs with per-replica timelines work too), computes
+per-phase distributions, fits the PERF.md latency models, and writes
+`report.md` + `cost_model.json` next to the inputs (or into --out).
+
+Exit status: 0 on a usable report, 2 when the run dir is degenerate
+(no timeline records at all — the CI gate for an empty smoke leg), 1
+when the fitted step model misses the OBS_REPORT_MAX_MAE_PCT bar.
+Deterministic and device-free: safe on any checkout, no jax import.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from distributed_pytorch_tpu.obs import replay  # noqa: E402
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("run_dir", help="runs/<run> directory to analyze")
+    p.add_argument("--out", default=None,
+                   help="artifact dir for report.md/cost_model.json "
+                        "(default: the run dir)")
+    p.add_argument("--json", action="store_true",
+                   help="print the full analysis as one JSON line")
+    args = p.parse_args(argv)
+    if not os.path.isdir(args.run_dir):
+        print(f"obs_report: no such run dir: {args.run_dir}",
+              file=sys.stderr)
+        return 2
+    a = replay.write_report(args.run_dir, out_dir=args.out)
+    if args.json:
+        print(json.dumps(a, sort_keys=True))
+    else:
+        print(f"report:     {a['report_md']}")
+        print(f"cost model: {a['cost_model_json']}")
+        for kind in ("engine", "trace", "train", "supervisor"):
+            n = len(a["files"][kind])
+            if n:
+                print(f"  {kind}: {n} file(s)")
+        for note in a["notes"]:
+            print(f"  warning: {note}")
+    if a["degenerate"]:
+        print("obs_report: DEGENERATE — no timeline records found",
+              file=sys.stderr)
+        return 2
+    return 1 if a["notes"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -197,6 +197,17 @@ register_knob("OBS_REPORT_MAX_MAE_PCT", "20", float,
               "obs_report acceptance bar: max median absolute pct error "
               "of the fitted step-time model before the fit is flagged")
 
+# --- static analysis (parallel/commscheck.py, ISSUE 15) ---
+register_knob("COMMSCHECK_TRACE", "auto",
+              lambda s: s.strip().lower() or "auto",
+              "commscheck jaxpr-trace scope: auto (124M cells fully, "
+              "ladder rungs at representative recipes) | full (every "
+              "matrix cell — minutes) | off (spec-derived model only)")
+register_knob("COMMSCHECK_DEVICES", "8", int,
+              "virtual CPU devices the commscheck CLI requests before "
+              "touching a backend (compat.request_cpu_devices); the "
+              "default fits the 4x2 matrix meshes")
+
 
 ACTIVATIONS = (
     "relu", "gelu", "swish", "mish", "silu", "selu", "celu", "elu",

@@ -92,6 +92,160 @@ from distributed_pytorch_tpu.parallel import context
 RETIRE_REASONS = ("eos", "budget", "cache_full", "cancelled", "preempted")
 
 
+# ----------------------------------------------------------------------
+# device-program factories
+# ----------------------------------------------------------------------
+# The engine's three compiled families live at MODULE level so the static
+# comms auditor (parallel/commscheck.py) traces the exact program the
+# engine serves — a copy of the step body in the auditor would drift the
+# first time the engine changed. `on_trace` carries the engine's
+# trace-guard side effect; the auditor passes None (its traces must not
+# count against a live engine's budget).
+
+def make_step_fn(model, sample_fn, *, on_trace=None):
+    """Plain decode step: advance every live slot by one token."""
+
+    def step(variables, caches, tok, pos, live, bt, rng, t, qparams):
+        if on_trace is not None:
+            on_trace()  # trace-time side effect
+        from distributed_pytorch_tpu.ops.quant import use_quantized_params
+        with use_quantized_params(qparams):
+            # quantized weights (when a store is active): decode
+            # matmuls read int8 codes instead of the bf16 kernels —
+            # the unused bf16 leaves are pruned from the compiled step
+            logits, _, caches = model.apply(
+                variables, tok[:, None], None, caches, pos,
+                deterministic=True, block_tables=bt)
+        nxt = sample_fn(logits[:, -1, :], jax.random.fold_in(rng, t))
+        # dead slots: freeze the token and position (their table row is
+        # zeroed, so the write lands in the null block — nothing reads
+        # it, no cleanup needed)
+        nxt = jnp.where(live, nxt, tok)
+        pos = pos + live.astype(jnp.int32)
+        return caches, nxt, pos
+
+    return step
+
+
+def make_fused_step_fn(model, sample_fn, n_slots: int, table_width: int,
+                       *, on_trace=None):
+    """The chunked-prefill step: ONE program that runs <=N prefill tokens
+    of one partial prompt plus every live decode token. The chunk buffer
+    is a fixed (1, prefill_chunk) shape; the target slot, block-aligned
+    write offset, and valid length are traced, so the whole serving mix
+    shares this single trace (the chunked analogue of `prefix_len` being
+    traced in the wave admit)."""
+    W = table_width
+
+    def fused_step(variables, caches, tok, pos, live, bt, rng, t,
+                   qparams, ctoks, cslot, coff, clen, cdone):
+        if on_trace is not None:
+            on_trace()  # trace-time side effect
+        # chunk prefill: write [coff, coff+N) of the chunk slot's
+        # logical sequence (rows past clen are pads landing in the
+        # null block via zero table entries) and attend causally over
+        # the sequence's own prior blocks. Runs OUTSIDE the quantized
+        # store, like the wave admit — prefill stays bf16 under
+        # weight-only int8.
+        bt_row = jax.lax.dynamic_slice(
+            bt, (cslot, jnp.int32(0)), (1, W))
+        clogits, _, caches = model.apply(
+            variables, ctoks, None, caches, coff, deterministic=True,
+            logits_idx=clen - 1, block_tables=bt_row)
+        first = sample_fn(clogits[:, -1, :],
+                          jax.random.fold_in(rng, 2 ** 21 + t))
+        from distributed_pytorch_tpu.ops.quant import use_quantized_params
+        with use_quantized_params(qparams):
+            logits, _, caches = model.apply(
+                variables, tok[:, None], None, caches, pos,
+                deterministic=True, block_tables=bt)
+        nxt = sample_fn(logits[:, -1, :], jax.random.fold_in(rng, t))
+        # dead/parked slots freeze their token; parked positions point
+        # at the null block so the decode write above was harmless
+        nxt = jnp.where(live, nxt, tok)
+        pos = pos + live.astype(jnp.int32)
+        # a chunk that completes its prompt activates the slot
+        # in-step: first sampled token + true position land exactly
+        # like a wave admit's would
+        sel = (jnp.arange(n_slots) == cslot) & cdone
+        nxt = jnp.where(sel, first[0], nxt)
+        pos = jnp.where(sel, coff + clen[0], pos)
+        live = jnp.logical_or(live, sel)
+        return caches, nxt, pos, live
+
+    return fused_step
+
+
+def make_admit_fn(model, sample_fn, *, on_trace=None):
+    """Wave-mode bucket prefill: suffix prefill straight into the slot's
+    pool blocks. One compiled program per pow2 bucket — the prompt buffer
+    shape is the bucket; prefix/true lengths and the slot are traced."""
+
+    def admit(variables, caches, tok, pos, live, bt, prompt, prefix_len,
+              true_len, slot, rng):
+        if on_trace is not None:
+            on_trace()
+        # the reused prefix is already resident, so the forward starts at
+        # prefix_len (TRACED — any prefix length shares this bucket's
+        # compiled program) and attends the whole logical view
+        bt_row = jax.lax.dynamic_slice(
+            bt, (slot, jnp.int32(0)), (1, bt.shape[1]))
+        logits, _, caches = model.apply(
+            variables, prompt, None, caches, prefix_len,
+            deterministic=True, logits_idx=true_len - 1,
+            block_tables=bt_row)
+        first = sample_fn(logits[:, -1, :], rng)
+        tok = tok.at[slot].set(first[0])
+        pos = pos.at[slot].set(prefix_len + true_len[0])
+        live = live.at[slot].set(True)
+        return caches, tok, pos, live, first
+
+    return admit
+
+
+def prefill_bucket_for(prompt_len: int, min_bucket: int, block_size: int,
+                       max_len: int) -> int:
+    """The pow2 bucket a (suffix of this length's) prefill runs in —
+    admissions sharing a bucket share one compiled prefill trace. The
+    floor is max(min_bucket, block_size) so buckets stay whole blocks."""
+    b = max(min_bucket, block_size)
+    while b < prompt_len:
+        b *= 2
+    return min(b, max_len)
+
+
+def enumerate_prefill_buckets(min_bucket: int, block_size: int,
+                              max_len: int) -> list:
+    """Every distinct bucket `prefill_bucket_for` can return over prompt
+    lengths 1..max_len — i.e. the complete static set of wave-admit
+    program signatures. Closed form, no tracing: the floor bucket, then
+    doublings clipped at max_len."""
+    buckets = []
+    b = min(max(min_bucket, block_size), max_len)
+    while True:
+        buckets.append(b)
+        if b >= max_len:
+            break
+        b = min(b * 2, max_len)
+    return buckets
+
+
+def enumerate_trace_signatures(*, min_bucket: int, block_size: int,
+                               max_len: int, prefill_chunk: int) -> dict:
+    """Statically enumerate the distinct compiled-program signatures one
+    engine configuration can legitimately build, keyed by trace-guard
+    family (obs/retrace.py). Chunked mode fuses prefill into the decode
+    step (one fused_step program, plus the chunk-free plain step), so its
+    admit count is 0 for ANY prompt mix; wave mode compiles one admit per
+    pow2 bucket. parallel/commscheck.py asserts these counts against the
+    engine's TraceGuard budgets at lint time."""
+    buckets = enumerate_prefill_buckets(min_bucket, block_size, max_len)
+    if prefill_chunk:
+        return {"step": 1, "fused_step": 1, "admit": 0, "buckets": []}
+    return {"step": 1, "fused_step": 0, "admit": len(buckets),
+            "buckets": buckets}
+
+
 @dataclasses.dataclass
 class Retired:
     """A finished sequence: its tokens (prompt + generated) and why it
@@ -381,76 +535,17 @@ class DecodeEngine:
     def _get_step_fn(self):
         if self._step_fn is not None:
             return self._step_fn
-
-        def step(variables, caches, tok, pos, live, bt, rng, t, qparams):
-            self.trace_guards["step"].mark()  # trace-time side effect
-            from distributed_pytorch_tpu.ops.quant import use_quantized_params
-            with use_quantized_params(qparams):
-                # quantized weights (when a store is active): decode
-                # matmuls read int8 codes instead of the bf16 kernels —
-                # the unused bf16 leaves are pruned from the compiled step
-                logits, _, caches = self.model.apply(
-                    variables, tok[:, None], None, caches, pos,
-                    deterministic=True, block_tables=bt)
-            nxt = self._sample(logits[:, -1, :], jax.random.fold_in(rng, t))
-            # dead slots: freeze the token and position (their table row is
-            # zeroed, so the write lands in the null block — nothing reads
-            # it, no cleanup needed)
-            nxt = jnp.where(live, nxt, tok)
-            pos = pos + live.astype(jnp.int32)
-            return caches, nxt, pos
-
+        step = make_step_fn(self.model, self._sample,
+                            on_trace=self.trace_guards["step"].mark)
         self._step_fn = jax.jit(step, donate_argnums=self._donate)
         return self._step_fn
 
     def _get_fused_step_fn(self):
-        """The chunked-prefill step: ONE jitted program that runs <=N
-        prefill tokens of one partial prompt plus every live decode
-        token. The chunk buffer is a fixed (1, prefill_chunk) shape; the
-        target slot, block-aligned write offset, and valid length are
-        traced, so the whole serving mix shares this single trace (the
-        chunked analogue of `prefix_len` being traced in the wave admit).
-        """
         if self._fused_step_fn is not None:
             return self._fused_step_fn
-        n_slots, W = self.n_slots, self.table_width
-
-        def fused_step(variables, caches, tok, pos, live, bt, rng, t,
-                       qparams, ctoks, cslot, coff, clen, cdone):
-            self.trace_guards["fused_step"].mark()  # trace-time side effect
-            # chunk prefill: write [coff, coff+N) of the chunk slot's
-            # logical sequence (rows past clen are pads landing in the
-            # null block via zero table entries) and attend causally over
-            # the sequence's own prior blocks. Runs OUTSIDE the quantized
-            # store, like the wave admit — prefill stays bf16 under
-            # weight-only int8.
-            bt_row = jax.lax.dynamic_slice(
-                bt, (cslot, jnp.int32(0)), (1, W))
-            clogits, _, caches = self.model.apply(
-                variables, ctoks, None, caches, coff, deterministic=True,
-                logits_idx=clen - 1, block_tables=bt_row)
-            first = self._sample(clogits[:, -1, :],
-                                 jax.random.fold_in(rng, 2 ** 21 + t))
-            from distributed_pytorch_tpu.ops.quant import \
-                use_quantized_params
-            with use_quantized_params(qparams):
-                logits, _, caches = self.model.apply(
-                    variables, tok[:, None], None, caches, pos,
-                    deterministic=True, block_tables=bt)
-            nxt = self._sample(logits[:, -1, :], jax.random.fold_in(rng, t))
-            # dead/parked slots freeze their token; parked positions point
-            # at the null block so the decode write above was harmless
-            nxt = jnp.where(live, nxt, tok)
-            pos = pos + live.astype(jnp.int32)
-            # a chunk that completes its prompt activates the slot
-            # in-step: first sampled token + true position land exactly
-            # like a wave admit's would
-            sel = (jnp.arange(n_slots) == cslot) & cdone
-            nxt = jnp.where(sel, first[0], nxt)
-            pos = jnp.where(sel, coff + clen[0], pos)
-            live = jnp.logical_or(live, sel)
-            return caches, nxt, pos, live
-
+        fused_step = make_fused_step_fn(
+            self.model, self._sample, self.n_slots, self.table_width,
+            on_trace=self.trace_guards["fused_step"].mark)
         self._fused_step_fn = jax.jit(fused_step,
                                       donate_argnums=self._donate)
         return self._fused_step_fn
@@ -460,26 +555,11 @@ class DecodeEngine:
         if fn is not None:
             return fn
 
-        def admit(variables, caches, tok, pos, live, bt, prompt, prefix_len,
-                  true_len, slot, rng):
+        def on_trace():
             self.trace_guards["admit"].mark()
             self.admit_traces[bucket] = self.admit_traces.get(bucket, 0) + 1
-            # suffix prefill straight into the slot's pool blocks: the
-            # reused prefix is already resident, so the forward starts at
-            # prefix_len (TRACED — any prefix length shares this bucket's
-            # compiled program) and attends the whole logical view
-            bt_row = jax.lax.dynamic_slice(
-                bt, (slot, jnp.int32(0)), (1, bt.shape[1]))
-            logits, _, caches = self.model.apply(
-                variables, prompt, None, caches, prefix_len,
-                deterministic=True, logits_idx=true_len - 1,
-                block_tables=bt_row)
-            first = self._sample(logits[:, -1, :], rng)
-            tok = tok.at[slot].set(first[0])
-            pos = pos.at[slot].set(prefix_len + true_len[0])
-            live = live.at[slot].set(True)
-            return caches, tok, pos, live, first
 
+        admit = make_admit_fn(self.model, self._sample, on_trace=on_trace)
         # a fresh bucket legitimately compiles one new program; a RE-trace
         # of an existing bucket stays over budget and trips the guard
         self.trace_guards["admit"].allow()
@@ -577,14 +657,10 @@ class DecodeEngine:
         raise KeyError(f"seq {seq_id} is not live")
 
     def prefill_bucket(self, prompt_len: int) -> int:
-        """The pow2 bucket a (suffix of this length's) prefill runs in —
-        admissions sharing a bucket share one compiled prefill trace. The
-        floor is max(min_bucket, block_size) so buckets stay whole
-        blocks."""
-        b = max(self.min_bucket, self.block_size)
-        while b < prompt_len:
-            b *= 2
-        return min(b, self.max_len)
+        """See `prefill_bucket_for` (module level, shared with the static
+        signature enumeration in parallel/commscheck.py)."""
+        return prefill_bucket_for(prompt_len, self.min_bucket,
+                                  self.block_size, self.max_len)
 
     def _retire_reason(self, slot: int, last_tok: int) -> Optional[str]:
         seq = self._slots[slot]

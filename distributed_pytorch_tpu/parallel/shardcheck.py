@@ -61,7 +61,8 @@ from jax.sharding import PartitionSpec as P
 from distributed_pytorch_tpu.config import (LLMConfig, PARALLELISM_RECIPES,
                                             PRESETS, TrainConfig)
 from distributed_pytorch_tpu.parallel import sharding as shd
-from distributed_pytorch_tpu.parallel.mesh import AXES, resolve_plan
+from distributed_pytorch_tpu.parallel.mesh import (AXES, resolve_plan,
+                                                   rung_down)
 
 # fraction of total params above which a leaf counts as "large" for the
 # replication / consistency rules
@@ -69,6 +70,12 @@ LARGE_FRAC = 0.01
 
 # default mesh shapes for the matrix: single host, 2-chip, 8-chip (4x2)
 DEFAULT_MESHES = ((1, 1), (2, 1), (4, 2))
+
+# elastic rung-down re-mesh cells (round 17): the supervisor re-meshes a
+# gang of n hosts down to the next power of two after a loss — the spec
+# tables must stay green on exactly those shrunken shapes, or an elastic
+# restart trades a dead host for a compile error
+RUNG_DOWN_GANGS = (2, 3, 5)
 
 # which mesh axis the second grid factor lands on, per recipe; the
 # data-family recipes compose tp on the leftover devices (resolve_plan's
@@ -112,6 +119,7 @@ class Report:
     mesh: dict[str, int]
     n_params: int = 0
     leaves_checked: int = 0
+    variant: str = ""    # e.g. 'rung_down:3->2' for re-mesh cells
     findings: list = dataclasses.field(default_factory=list)
 
     @property
@@ -129,7 +137,8 @@ class Report:
     def to_dict(self) -> dict:
         return {"preset": self.preset, "recipe": self.recipe,
                 "mesh": self.mesh, "n_params": self.n_params,
-                "leaves_checked": self.leaves_checked, "ok": self.ok,
+                "leaves_checked": self.leaves_checked,
+                "variant": self.variant, "ok": self.ok,
                 "findings": [f.to_dict() for f in self.findings]}
 
 
@@ -266,10 +275,12 @@ def _flat_params(shapes_tree):
 
 def check_config(model_cfg: LLMConfig, recipe: str,
                  sizes: dict[str, int], *, preset: str = "custom",
-                 batch_size: Optional[int] = None) -> Report:
+                 batch_size: Optional[int] = None,
+                 variant: str = "") -> Report:
     """Validate every spec table for one recipe on one mesh shape."""
     sizes = {a: int(sizes.get(a, 1)) for a in AXES}
-    report = Report(preset=preset, recipe=recipe, mesh=dict(sizes))
+    report = Report(preset=preset, recipe=recipe, mesh=dict(sizes),
+                    variant=variant)
     if sizes["pipe"] > 1:
         try:
             model_cfg = dataclasses.replace(model_cfg,
@@ -431,7 +442,8 @@ def check_matrix(presets: Optional[Iterable[str]] = None,
                  include_moe: bool = True) -> list[Report]:
     """The full golden matrix: every recipe x ladder preset x mesh shape
     (plus a MoE'd 124M under every mesh so 'ep' and the dispatch specs
-    are exercised meaningfully). 'single' is only defined at 1x1."""
+    are exercised meaningfully, plus the round-17 rung-down re-mesh
+    shapes per RUNG_DOWN_GANGS). 'single' is only defined at 1x1."""
     presets = list(presets or PRESETS)
     recipes = list(recipes or PARALLELISM_RECIPES)
     meshes = [tuple(m) for m in meshes]
@@ -449,6 +461,15 @@ def check_matrix(presets: Optional[Iterable[str]] = None,
                 out.append(check_config(
                     cfg, recipe, mesh_sizes_for(recipe, grid),
                     preset=pname))
+            if recipe == "single":
+                continue
+            # round-17 elastic re-mesh shapes: a gang of n survivors
+            # rungs down to the next power of two on the data grid
+            for n in RUNG_DOWN_GANGS:
+                down = rung_down(n)
+                out.append(check_config(
+                    cfg, recipe, mesh_sizes_for(recipe, (down, 1)),
+                    preset=pname, variant=f"rung_down:{n}->{down}"))
     return out
 
 
@@ -479,8 +500,9 @@ def check_train_config(model_cfg: LLMConfig, train_cfg: TrainConfig,
 def format_report(report: Report) -> str:
     mesh = ",".join(f"{a}={s}" for a, s in report.mesh.items() if s > 1) \
         or "1 device"
-    head = (f"shardcheck: {report.preset} x {report.recipe} on [{mesh}] — "
-            f"{report.n_params / 1e6:.0f}M params, "
+    tag = f" ({report.variant})" if report.variant else ""
+    head = (f"shardcheck: {report.preset} x {report.recipe} on "
+            f"[{mesh}]{tag} — {report.n_params / 1e6:.0f}M params, "
             f"{report.leaves_checked} leaves")
     lines = [head]
     for f in report.findings:

@@ -240,6 +240,30 @@ def make_train_step(model, tx: optax.GradientTransformation,
     ), guard)
 
 
+def trace_train_step(model, tx: optax.GradientTransformation,
+                     model_cfg: LLMConfig, train_cfg: TrainConfig,
+                     state_shapes, mesh: Optional[Mesh] = None,
+                     accum: int = 1):
+    """Trace — never run — the REAL jitted train step over abstract state.
+
+    The static comms auditor (parallel/commscheck.py) entry: builds the
+    same `make_train_step` program the trainer executes (same shardings,
+    same donation) and traces it with ShapeDtypeStructs, so the returned
+    `jax.stages.Traced` carries the closed jaxpr, per-argument donation
+    flags (`args_info`) and output avals without allocating a single
+    buffer. `state_shapes` is the eval_shape of the state init (see
+    train/state.create_train_state); batch shape is (accum, B, T) like
+    the real step's."""
+    from distributed_pytorch_tpu.train.state import state_shardings
+    sh = (state_shardings(state_shapes, train_cfg.parallelism, mesh)
+          if mesh is not None else None)
+    step = make_train_step(model, tx, model_cfg, train_cfg, mesh, sh)
+    batch = jax.ShapeDtypeStruct(
+        (accum, train_cfg.batch_size, model_cfg.block_size), jnp.int32)
+    # GuardedFn delegates .trace to the underlying PjitFunction
+    return step.trace(state_shapes, batch, batch)
+
+
 def make_eval_step(model, train_cfg: TrainConfig,
                    mesh: Optional[Mesh] = None,
                    state_sharding: Optional[Any] = None):

@@ -3,9 +3,10 @@
 checkers and pyflakes can't see, each of which has bitten a round of this
 repo:
 
-* ``host-sync``   — `jax.device_get(...)`, `.item()`, `float(jnp...)` /
-  `int(jax...)`, and `np.asarray(...)` on the traced hot-path modules
-  (train/step.py, engine/decode.py, models/, ops/). Each forces a
+* ``host-sync``   — `jax.device_get(...)`, `.item()`, `.tolist()`,
+  `float(jnp...)` / `int(jax...)`, and `np.asarray(...)` /
+  `np.array(...)` on the traced hot-path modules
+  (train/step.py, engine/, models/, ops/). Each forces a
   device->host round trip that serializes the async dispatch pipeline the
   train loop and engine are built around. The deliberate sync boundaries
   (the engine's wave-admit first-token read and step-end token drain)
@@ -20,6 +21,10 @@ repo:
 * ``pallas-gate`` — a module that issues `pallas_call` must define a
   `*_usable` capability gate: every kernel needs a declared fallback
   predicate or it crashes on CPU/older TPUs instead of falling back.
+* ``knob-docs``   — README's env-knob table (the
+  `<!-- knobs:begin -->` block) must byte-match the table generated
+  from config.py's ENV_KNOBS registry — names, defaults, and docs; a
+  knob added or re-defaulted without `--write-knob-docs` fails CI.
 
 Scoping: walking the package applies each rule only where it means
 something (see _rules_for). Explicitly listed files get EVERY rule —
@@ -51,7 +56,7 @@ PKG = os.path.join(REPO, "distributed_pytorch_tpu")
 RULES = ("host-sync", "wall-clock", "env-read", "pallas-gate")
 
 # modules whose bodies run (mostly) under jit tracing — the host-sync scope
-_HOT_PATHS = ("train/step.py", "engine/decode.py", "models/", "ops/")
+_HOT_PATHS = ("train/step.py", "engine/", "models/", "ops/")
 _ALLOW_RE = re.compile(r"#\s*lint:\s*allow\(([^)]*)\)")
 
 
@@ -132,16 +137,17 @@ class _Visitor(ast.NodeVisitor):
                 self.has_pallas is None:
             self.has_pallas = node.lineno
 
-        if chain in ("jax.device_get", "np.asarray", "numpy.asarray"):
+        if chain in ("jax.device_get", "np.asarray", "numpy.asarray",
+                     "np.array", "numpy.array"):
             self._flag(node, "host-sync",
                        f"{chain}() forces a device->host sync on a "
                        f"traced hot path")
         elif isinstance(node.func, ast.Attribute) and \
-                node.func.attr == "item" and not node.args and \
-                not node.keywords:
+                node.func.attr in ("item", "tolist") and not node.args \
+                and not node.keywords:
             self._flag(node, "host-sync",
-                       ".item() forces a device->host sync on a traced "
-                       "hot path")
+                       f".{node.func.attr}() forces a device->host sync "
+                       f"on a traced hot path")
         elif isinstance(node.func, ast.Name) and \
                 node.func.id in ("float", "int") and len(node.args) == 1:
             arg = node.args[0]
@@ -200,6 +206,72 @@ def lint_file(path: str, rules: Optional[set[str]] = None,
     return v.findings
 
 
+# ---------------------------------------------------------------------------
+# knob-docs: README's env-knob table must match config.py's registry
+# ---------------------------------------------------------------------------
+
+KNOB_BEGIN = "<!-- knobs:begin -->"
+KNOB_END = "<!-- knobs:end -->"
+README = os.path.join(REPO, "README.md")
+
+
+def knob_docs_block() -> str:
+    """The generated README table: one row per registered knob (name,
+    default, doc), sorted — regenerate with --write-knob-docs."""
+    sys.path.insert(0, REPO)
+    from distributed_pytorch_tpu import config
+    rows = ["| knob | default | what it tunes |", "|---|---|---|"]
+    for k in sorted(config.ENV_KNOBS.values(), key=lambda k: k.name):
+        doc = k.doc.replace("|", "\\|")   # literal pipes break md cells
+        rows.append(f"| `{k.name}` | `{k.default}` | {doc} |")
+    return "\n".join([KNOB_BEGIN] + rows + [KNOB_END])
+
+
+def check_knob_docs(readme: str = README) -> list[Finding]:
+    """Doc-drift rule: the README block between the knobs markers must
+    equal the table generated from config.ENV_KNOBS — a knob added,
+    renamed, or re-defaulted without a doc update fails CI."""
+    with open(readme) as f:
+        text = f.read()
+    rel = os.path.relpath(readme, REPO)
+    b, e = text.find(KNOB_BEGIN), text.find(KNOB_END)
+    if b < 0 or e < 0:
+        return [Finding("knob-docs", rel, 1,
+                        f"README has no {KNOB_BEGIN}..{KNOB_END} block — "
+                        f"run scripts/lint.py --write-knob-docs")]
+    current = text[b:e + len(KNOB_END)]
+    want = knob_docs_block()
+    if current != want:
+        line = text[:b].count("\n") + 1
+        cur_rows = set(current.splitlines())
+        drift = [r for r in want.splitlines() if r not in cur_rows]
+        stale = [r for r in current.splitlines()
+                 if r not in set(want.splitlines())]
+        detail = ("README knob table drifted from config.ENV_KNOBS "
+                  f"({len(drift)} missing/changed, {len(stale)} stale "
+                  "row(s)) — run scripts/lint.py --write-knob-docs")
+        if drift:
+            detail += f"; e.g. missing: {drift[0][:120]}"
+        return [Finding("knob-docs", rel, line, detail)]
+    return []
+
+
+def write_knob_docs(readme: str = README) -> bool:
+    """Regenerate the README block in place; True if the file changed."""
+    with open(readme) as f:
+        text = f.read()
+    b, e = text.find(KNOB_BEGIN), text.find(KNOB_END)
+    if b < 0 or e < 0:
+        raise SystemExit(f"{readme}: no {KNOB_BEGIN}..{KNOB_END} block "
+                         "to rewrite — add the markers first")
+    new = text[:b] + knob_docs_block() + text[e + len(KNOB_END):]
+    if new != text:
+        with open(readme, "w") as f:
+            f.write(new)
+        return True
+    return False
+
+
 def lint_package(root: str = PKG) -> list[Finding]:
     findings: list[Finding] = []
     for dirpath, _, files in sorted(os.walk(root)):
@@ -220,7 +292,16 @@ def main(argv: Optional[list[str]] = None) -> int:
                     "walk distributed_pytorch_tpu/ with scoped rules")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable findings on stdout")
+    ap.add_argument("--write-knob-docs", action="store_true",
+                    help="regenerate README's env-knob table from "
+                    "config.ENV_KNOBS and exit")
     args = ap.parse_args(argv)
+
+    if args.write_knob_docs:
+        changed = write_knob_docs()
+        print(f"knob docs: {'rewrote' if changed else 'unchanged'} "
+              f"{os.path.relpath(README, REPO)}")
+        return 0
 
     if args.files:
         findings = []
@@ -228,7 +309,7 @@ def main(argv: Optional[list[str]] = None) -> int:
             findings += lint_file(f, rules=set(RULES),
                                   rel=os.path.relpath(f, REPO))
     else:
-        findings = lint_package()
+        findings = lint_package() + check_knob_docs()
 
     if args.json:
         print(json.dumps({"ok": not findings,

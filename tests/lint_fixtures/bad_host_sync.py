@@ -11,7 +11,9 @@ def traced_body(x, first):
     f = float(jnp.mean(x))           # float() on a device value
     i = int(jax.device_get(first))   # int() on a device value
     a = np.asarray(x)                # np.asarray materializes on host
-    return y, z, f, i, a
+    b = np.array(x)                  # np.array copies to host too
+    t = x.tolist()                   # .tolist() drains the whole array
+    return y, z, f, i, a, b, t
 
 
 def allowed_body(x):

@@ -24,8 +24,9 @@ from distributed_pytorch_tpu.config import (PARALLELISM_RECIPES, PRESETS,
                                             TrainConfig)
 from distributed_pytorch_tpu.obs.retrace import (RetraceError, TraceGuard,
                                                  guarded)
-from distributed_pytorch_tpu.parallel import shardcheck, sharding as shd
-from distributed_pytorch_tpu.parallel.mesh import AXES
+from distributed_pytorch_tpu.parallel import commscheck, shardcheck, \
+    sharding as shd
+from distributed_pytorch_tpu.parallel.mesh import AXES, MeshPlan, build_mesh
 
 REPO = pathlib.Path(__file__).resolve().parents[1]
 FIXTURES = REPO / "tests" / "lint_fixtures"
@@ -188,11 +189,12 @@ def test_lint_package_is_clean():
 def test_lint_host_sync_fixture():
     out = lint.lint_file(FIXTURES / "bad_host_sync.py",
                          rules=("host-sync",), rel="ops/fixture.py")
-    assert _rules(out) == ["host-sync"] * 6
-    # device_get, .item(), float(jnp...), int(device_get) twice, asarray
-    assert sorted(f.line for f in out) == [9, 10, 11, 12, 12, 13]
-    # the tagged line (19) must not appear
-    assert all(f.line != 19 for f in out)
+    assert _rules(out) == ["host-sync"] * 8
+    # device_get, .item(), float(jnp...), int(device_get) twice, asarray,
+    # np.array, .tolist()
+    assert sorted(f.line for f in out) == [9, 10, 11, 12, 12, 13, 14, 15]
+    # the tagged line (21) must not appear
+    assert all(f.line != 21 for f in out)
 
 
 def test_lint_wallclock_fixture():
@@ -252,13 +254,23 @@ def test_lint_main_exit_codes(capsys):
 
 def test_matrix_green():
     """Every recipe x ladder preset x {1x1, 2x1, 4x2} mesh (plus the MoE
-    variant) validates with zero errors, entirely device-free."""
+    variant, plus the round-17 rung-down re-mesh shapes) validates with
+    zero errors, entirely device-free."""
     reports = shardcheck.check_matrix()
-    # 5 configs (4 ladder rungs + moe'd 124m) x (9 recipes x 3 meshes +
-    # 'single' at 1x1 only)
-    assert len(reports) == 5 * (9 * 3 + 1)
+    # 5 configs (4 ladder rungs + moe'd 124m) x (9 recipes x (3 meshes +
+    # 3 rung-down re-mesh cells) + 'single' at 1x1 only)
+    assert len(reports) == 5 * (9 * (3 + 3) + 1)
     bad = [r for r in reports if not r.ok]
     assert not bad, "\n\n".join(shardcheck.format_report(r) for r in bad)
+    # the elastic cells are present, labeled, and on the shrunken grids
+    rung = [r for r in reports if r.variant.startswith("rung_down:")]
+    assert len(rung) == 5 * 9 * 3
+    assert {r.variant for r in rung} == {
+        "rung_down:2->1", "rung_down:3->2", "rung_down:5->4"}
+    for r in rung:
+        down = int(r.variant.split("->")[1])
+        assert r.mesh["data"] == down
+        assert all(s == 1 for a, s in r.mesh.items() if a != "data")
 
 
 def test_1p5b_tp_cache_warns_but_passes():
@@ -406,3 +418,271 @@ def test_every_recipe_has_a_secondary_axis_mapping():
         sizes = shardcheck.mesh_sizes_for(recipe, (2, 2))
         assert sum(1 for s in sizes.values() if s > 1) == 2
         assert set(sizes) == set(AXES)
+
+
+# ---------------------------------------------------------------------------
+# commscheck: explicit collective inventory (jaxpr walk + bytes math)
+# ---------------------------------------------------------------------------
+
+def test_collective_inventory_bytes_hand_computed():
+    """One psum over a 2-device data axis: the inventory must price it at
+    exactly the PER-SHARD operand aval (shard_map bodies see shard
+    shapes), here (4, 4) f32 = 64 bytes."""
+    from jax.experimental.shard_map import shard_map
+    mesh = build_mesh(MeshPlan(data=2))
+
+    def f(x):
+        return jax.lax.psum(x, "data")
+
+    sm = shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P())
+    jaxpr = jax.make_jaxpr(sm)(jnp.zeros((8, 4), jnp.float32))
+    inv = commscheck.collective_inventory(jaxpr)
+    assert [(c["family"], c["prim"], c["axes"], c["count"], c["bytes"])
+            for c in inv] == [("all_reduce", "psum2", ["data"], 1, 64)]
+
+
+def test_collective_inventory_scan_weighting():
+    """A psum inside a length-4 scan body executes 4x per step — the
+    inventory multiplies count AND bytes by the trip count."""
+    from jax.experimental.shard_map import shard_map
+    mesh = build_mesh(MeshPlan(data=2))
+
+    def f(x):
+        def body(c, xs):
+            return c + jax.lax.psum(xs, "data"), None
+        out, _ = jax.lax.scan(body, jnp.zeros((4,), jnp.float32), x)
+        return out
+
+    # check_rep=False keeps the plain psum primitive (and sidesteps the
+    # scan-carry replication-type check) — both spellings must count
+    sm = shard_map(f, mesh=mesh, in_specs=P("data"), out_specs=P(),
+                   check_rep=False)
+    jaxpr = jax.make_jaxpr(sm)(jnp.zeros((8, 4), jnp.float32))
+    inv = commscheck.collective_inventory(jaxpr)
+    # per-shard leading dim 8/2=4 -> scan length 4; operand (4,) f32=16 B
+    assert [(c["prim"], c["count"], c["bytes"]) for c in inv] == \
+        [("psum", 4, 64)]
+
+
+# ---------------------------------------------------------------------------
+# commscheck: donation verification (aval-level aliasing)
+# ---------------------------------------------------------------------------
+
+def test_donation_report_all_consumed():
+    def ok(a, b):
+        return a + 1.0, b * 2
+
+    tr = jax.jit(ok, donate_argnums=(0, 1)).trace(
+        jax.ShapeDtypeStruct((8,), jnp.float32),
+        jax.ShapeDtypeStruct((4,), jnp.int32))
+    don = commscheck.donation_report(tr)
+    assert (don["donated"], don["consumed"], don["n_missed"]) == (2, 2, 0)
+    assert don["donated_bytes"] == 8 * 4 + 4 * 4
+
+
+def test_donation_miss_flagged_as_error():
+    """A donated buffer with no shape/dtype-matched output (the dtype
+    changed under it) is a silent donation miss — rule donation-miss."""
+    def bad(a):
+        return a.astype(jnp.float32)
+
+    tr = jax.jit(bad, donate_argnums=(0,)).trace(
+        jax.ShapeDtypeStruct((8,), jnp.bfloat16))
+    don = commscheck.donation_report(tr)
+    assert (don["donated"], don["consumed"], don["n_missed"]) == (1, 0, 1)
+    assert don["missed"] == [{"shape": [8], "dtype": "bfloat16"}]
+    rep = commscheck.CommsReport(key="t", role="train", preset="p",
+                                 recipe="single", mesh={})
+    commscheck._donation_findings(rep, "step", don)
+    assert [f.rule for f in rep.findings] == ["donation-miss"]
+    assert not rep.ok
+
+
+# ---------------------------------------------------------------------------
+# commscheck: derived GSPMD model bytes vs hand-computed sizes
+# ---------------------------------------------------------------------------
+
+def test_derived_train_comms_bytes_hand_computed():
+    cfg = PRESETS["gpt2_124m"]()
+    sizes = shardcheck.mesh_sizes_for("fsdp", (2, 1))
+    tcfg = TrainConfig(parallelism="fsdp", batch_size=4)
+    entries, findings = commscheck.derived_train_comms(
+        cfg, "fsdp", sizes, tcfg, accum=2)
+    assert findings == []
+    total = commscheck._n_params(cfg)
+    by = {e["origin"]: e for e in entries}
+    # fsdp grads: reduce-scatter of fp32 grads once per micro-step
+    assert by["grads"]["family"] == "reduce_scatter"
+    assert by["grads"]["bytes"] == total * 4 * 2
+    # fsdp param gathers: bf16 params per micro-step (overlap=auto does
+    # not hoist them out of the accumulation scan)
+    act = jnp.dtype(tcfg.compute_dtype).itemsize
+    assert by["param-gather"]["family"] == "all_gather"
+    assert by["param-gather"]["bytes"] == total * act * 2
+    assert by["param-gather"]["hoisted"] is False
+
+
+def test_derived_sp_ring_matches_traced_ppermute_bytes():
+    """The derived sp-ring formula must price the ring EXACTLY like the
+    jaxpr says: per-step ppermute bytes at sp/4x2 match the traced
+    zig-zag ring's scan-weighted inventory."""
+    [r] = commscheck.check_cells(["train/gpt2_124m/sp/4x2"])
+    assert r.traced and r.ok
+    ring = [c for c in r.collectives if c["family"] == "ppermute"]
+    derived = [d for d in r.derived if d["origin"] == "sp-ring"]
+    assert len(ring) == 1 and len(derived) == 1
+    assert ring[0]["bytes"] == derived[0]["bytes"]
+
+
+def test_mutation_replicated_grads_flag_promised_reduce_scatter(
+        monkeypatch):
+    """Seeded mutation: a grads table that silently replicates under a
+    sharded-grad recipe must raise promised-reduce-scatter (the silent
+    all-reduce regression)."""
+    monkeypatch.setattr(
+        shd, "grads_pspecs",
+        lambda shapes, specs, recipe, mesh: jax.tree_util.tree_map(
+            lambda s: P(), specs, is_leaf=lambda x: isinstance(x, P)))
+    cfg = PRESETS["gpt2_124m"]()
+    sizes = shardcheck.mesh_sizes_for("fsdp", (2, 1))
+    tcfg = TrainConfig(parallelism="fsdp", batch_size=4)
+    entries, findings = commscheck.derived_train_comms(
+        cfg, "fsdp", sizes, tcfg, accum=2)
+    assert any(f.rule == "promised-reduce-scatter" and
+               f.severity == "error" for f in findings)
+    by = {e["origin"]: e for e in entries}
+    assert by["grads"]["family"] == "all_reduce"  # the degraded class
+
+
+# ---------------------------------------------------------------------------
+# commscheck: trace-signature enumeration vs retrace budgets
+# ---------------------------------------------------------------------------
+
+def test_decode_signatures_within_budget_both_modes():
+    wave = commscheck.check_cells(
+        ["decode/gpt2_124m/single/1x1/wave"], trace_mode="off")[0]
+    chunked = commscheck.check_cells(
+        ["decode/gpt2_124m/single/1x1/chunked"], trace_mode="off")[0]
+    assert wave.ok and chunked.ok
+    ws = wave.signatures["enumerated"]
+    assert ws["fused_step"] == 0 and ws["admit"] == len(ws["buckets"])
+    assert ws["buckets"] == sorted(set(ws["buckets"]))  # distinct, sorted
+    cs = chunked.signatures["enumerated"]
+    assert cs == {"step": 1, "fused_step": 1, "admit": 0, "buckets": []}
+
+
+def test_mutation_bucketing_bug_fails_signature_enumeration(monkeypatch):
+    """Seeded mutation: an identity 'bucketing' that compiles one program
+    per prompt length must fail the closed-form vs brute-force
+    cross-check at lint time."""
+    from distributed_pytorch_tpu.engine import decode as eng
+    monkeypatch.setattr(eng, "prefill_bucket_for",
+                        lambda n, mb, bs, ml: min(max(n, mb), ml))
+    [r] = commscheck.check_cells(["decode/gpt2_124m/single/1x1/wave"],
+                                 trace_mode="off")
+    assert any(f.rule == "signature-enumeration" for f in r.findings)
+    assert not r.ok
+
+
+def test_mutation_extra_trace_signature_breaks_budget(monkeypatch):
+    """Seeded mutation: a third step signature exceeds the TraceGuard
+    budget of 1 — rule trace-budget."""
+    from distributed_pytorch_tpu.engine import decode as eng
+    real = eng.enumerate_trace_signatures
+
+    def seeded(**kw):
+        sigs = dict(real(**kw))
+        sigs["step"] = 3
+        return sigs
+
+    monkeypatch.setattr(eng, "enumerate_trace_signatures", seeded)
+    [r] = commscheck.check_cells(["decode/gpt2_124m/single/1x1/chunked"],
+                                 trace_mode="off")
+    assert any(f.rule == "trace-budget" and f.path == "step"
+               for f in r.findings)
+    assert not r.ok
+
+
+# ---------------------------------------------------------------------------
+# commscheck: golden round trip + seeded divergence
+# ---------------------------------------------------------------------------
+
+def _cell_diffs(golden, report):
+    diffs = []
+    commscheck._diff_value(report.key, golden["reports"][report.key],
+                           report.to_dict(), diffs)
+    return diffs
+
+
+def test_commscheck_golden_round_trip():
+    """Re-auditing golden cells reproduces the committed matrix byte for
+    byte: same collectives, bytes, donation, signatures, findings."""
+    golden = commscheck.load_golden()
+    assert golden is not None and golden["ok"]
+    for key in ("train/gpt2_124m/fsdp/2x1",
+                "decode/gpt2_124m/single/1x1/chunked"):
+        [r] = commscheck.check_cells([key])
+        assert r.traced
+        assert _cell_diffs(golden, r) == []
+
+
+def test_mutation_extra_psum_diverges_from_golden(monkeypatch):
+    """Seeded mutation: one extra collective in the traced step shows up
+    as a golden diff — the refactor-gate property."""
+    real = commscheck.collective_inventory
+
+    def seeded(jaxpr):
+        inv = real(jaxpr)
+        inv.append({"family": "all_reduce", "prim": "psum",
+                    "axes": ["data"], "count": 1, "bytes": 4096})
+        return inv
+
+    monkeypatch.setattr(commscheck, "collective_inventory", seeded)
+    golden = commscheck.load_golden()
+    [r] = commscheck.check_cells(["train/gpt2_124m/fsdp/2x1"])
+    diffs = _cell_diffs(golden, r)
+    assert diffs and any("collectives" in d for d in diffs)
+
+
+def test_mutation_dropped_donation_diverges_and_errors(monkeypatch):
+    """Seeded mutation: a donation miss both fails the cell (error
+    finding) and diverges from the golden donation table."""
+    real = commscheck.donation_report
+
+    def seeded(traced):
+        don = real(traced)
+        if don["donated"]:
+            don["consumed"] -= 1
+            don["n_missed"] += 1
+            don["missed"] = [{"shape": [1], "dtype": "float32"}]
+        return don
+
+    monkeypatch.setattr(commscheck, "donation_report", seeded)
+    golden = commscheck.load_golden()
+    [r] = commscheck.check_cells(["train/gpt2_124m/fsdp/2x1"])
+    assert any(f.rule == "donation-miss" for f in r.findings)
+    assert not r.ok
+    diffs = _cell_diffs(golden, r)
+    assert any("donation" in d for d in diffs)
+
+
+def test_diff_golden_trace_mode_mismatch_short_circuits():
+    payload = {"trace_mode": "off", "reports": {}}
+    golden = {"trace_mode": "auto", "reports": {}}
+    diffs = commscheck.diff_golden(payload, golden)
+    assert len(diffs) == 1 and "trace_mode" in diffs[0]
+
+
+def test_golden_covers_shardcheck_matrix_plus_engine_cells():
+    """The committed golden must stay in lockstep with the audit scope:
+    every train cell of the base matrix, the overlap A/B pair, and the
+    four engine cells."""
+    golden = commscheck.load_golden()
+    keys = set(golden["reports"])
+    assert "train/gpt2_124m/fsdp/2x1/overlap-accum1" in keys
+    assert "train/gpt2_124m/fsdp/2x1/overlap-accum2" in keys
+    decode = {k for k in keys if k.startswith("decode/")}
+    assert len(decode) == len(commscheck.DECODE_CELLS)
+    # 5 configs x (9 recipes x 3 meshes + single@1x1) + 2 overlap + 4
+    assert len(keys) == 5 * (9 * 3 + 1) + 2 + 4
+    assert golden["errors"] == 0 and golden["ok"]

@@ -727,6 +727,178 @@ def _serve_chunked_bench(platform: str) -> dict:
             "preset": preset}
 
 
+def _serve_spec_bench(platform: str) -> dict:
+    """serve_load_spec leg (BENCH_SERVE=1 BENCH_SERVE_SPEC=1): the
+    speculative-decoding A/B (ISSUE 16). Repetitive-suffix Poisson
+    traffic (prompts tile a short pattern, so the n-gram drafter has
+    something to hit) drives a GREEDY engine twice under the SAME seeded
+    arrivals: spec off, then a BENCH_SPEC_K sweep with SPEC_DECODE=on.
+    Greedy verify is exact, so every leg streams bit-identical tokens —
+    the comparison isolates steps-per-token, not output quality. The
+    acceptance booleans the ISSUE pins: accepted_token_rate > 0 on this
+    traffic, and delivered tok/s at the best K >= the spec-off baseline
+    (same weight-read count per step, fewer steps per token)."""
+    import asyncio
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_pytorch_tpu.config import LLMConfig, flagship_gpt124m
+    from distributed_pytorch_tpu.engine import DecodeEngine
+    from distributed_pytorch_tpu.models.gpt import LLM
+    from distributed_pytorch_tpu.serve.scheduler import Scheduler, ShedError
+
+    n_dev = len(jax.devices())
+    if platform == "tpu":
+        cfg = flagship_gpt124m()
+        S = int(os.environ.get("BENCH_DECODE_LEN", "1024"))
+        slots = int(os.environ.get("BENCH_DECODE_SLOTS", "32"))
+        kv_block = int(os.environ.get("BENCH_KV_BLOCK", "128"))
+        dtype = jnp.bfloat16
+        n_req, p_lo, p_hi, b_lo, b_hi = 96, 64, 256, 16, 64
+        preset = "gpt2_124m"
+    else:  # CPU proxy: tiny model, same traffic shape
+        cfg = LLMConfig(vocab_size=1024, block_size=128, n_embd=128,
+                        n_head=4, n_kv_heads=4, attn="mha", n_layer=2,
+                        up_dim=256, non_linearity="swiglu", pos_emb="rope")
+        S, slots, dtype = 128, 4, jnp.float32
+        kv_block = int(os.environ.get("BENCH_KV_BLOCK", "16"))
+        n_req, p_lo, p_hi, b_lo, b_hi = 24, 12, 48, 8, 16
+        preset = "cpu_tiny"
+    model = LLM(cfg, compute_dtype=dtype, attn_impl="auto")
+    rng = jax.random.PRNGKey(0)
+    dummy = jnp.zeros((1, cfg.block_size), jnp.int32)
+    variables = jax.jit(model.init)({"params": rng, "dropout": rng},
+                                    dummy, dummy)
+    ks = [int(k) for k in
+          os.environ.get("BENCH_SPEC_K", "2,4").split(",") if k.strip()]
+
+    def make_engine(spec_k: int) -> "DecodeEngine":
+        # temperature=0.0: speculation is greedy-only (the verify is an
+        # exact argmax match), and the off/on A/B must sample identically
+        return DecodeEngine(model, variables, n_slots=slots, max_len=S,
+                            temperature=0.0, block_size=kv_block,
+                            spec_decode=spec_k > 0,
+                            spec_k=spec_k or None)
+
+    # repetitive-suffix traffic: each prompt tiles a short random pattern,
+    # so the suffix n-gram always has an earlier occurrence to extend —
+    # the regime speculation targets (code, templated text, self-loops)
+    npr = np.random.default_rng(0)
+    reqs = []
+    for _ in range(n_req):
+        plen = int(npr.integers(p_lo, p_hi))
+        pat = list(npr.integers(0, cfg.vocab_size,
+                                int(npr.integers(3, 7))))
+        prompt = (pat * (plen // len(pat) + 1))[:plen]
+        reqs.append((prompt, int(npr.integers(b_lo, b_hi))))
+
+    # probe the plain fused step for the arrival rate; every leg replays
+    # the SAME arrival offsets so the comparison is traffic-identical
+    probe = make_engine(0)
+    for bucket in sorted({probe.prefill_bucket(len(p)) for p, _ in reqs}):
+        probe.admit(list(npr.integers(0, cfg.vocab_size, bucket)), 1)
+    while probe.free_slots:
+        probe.admit(reqs[0][0], 10 ** 9)
+    probe.step()
+    t0 = time.perf_counter()
+    probe_steps = 8
+    for _ in range(probe_steps):
+        probe.step()
+    jax.device_get(probe.tok)
+    step_s = (time.perf_counter() - t0) / probe_steps
+    for sid in probe.live_seq_ids:
+        probe.set_budget(sid, 1)
+    while probe.n_live:
+        probe.step()
+
+    mean_budget = (b_lo + b_hi) / 2
+    load = float(os.environ.get("BENCH_SERVE_LOAD", "1.0"))
+    rate = slots / (mean_budget * step_s) * load
+    arrivals = np.cumsum(npr.exponential(1.0 / rate, size=n_req))
+
+    def drive(e):
+        async def _run():
+            sched = Scheduler(e, max_queue=4 * slots)
+            await sched.start()
+            consumers, shed = [], 0
+            start = time.perf_counter()
+            for (prompt, budget), at in zip(reqs, arrivals):
+                delay = start + at - time.perf_counter()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                try:
+                    h = sched.submit(prompt, budget)
+                except ShedError:
+                    shed += 1
+                    continue
+                consumers.append(asyncio.ensure_future(h.result()))
+            await asyncio.gather(*consumers, return_exceptions=True)
+            dt = time.perf_counter() - start
+            await sched.stop()
+            return sched, shed, dt
+
+        return asyncio.run(_run())
+
+    def leg(spec_k: int) -> dict:
+        e = make_engine(spec_k)
+        # warm the prefill buckets + both step programs outside the window
+        for bucket in sorted({e.prefill_bucket(len(p)) for p, _ in reqs}):
+            e.admit(list(npr.integers(0, cfg.vocab_size, bucket)), 1)
+        e.admit(reqs[0][0], 4)
+        while e.n_live:
+            e.step()
+        sched, shed, dt = drive(e)
+        s = sched.metrics.summary()
+        return {"spec_k": spec_k,
+                "tokens_per_sec_per_chip": round(
+                    sched.metrics.counters["tokens_out"] / dt / n_dev, 1),
+                "accepted_token_rate": round(e.accepted_token_rate, 4),
+                "tokens_per_step": round(e.tokens_per_step, 3),
+                "drafted": e.spec_drafted_tokens,
+                "accepted": e.spec_accepted_tokens,
+                "spec_step_traces": e.spec_step_traces,
+                "ttft_p50_ms": s["ttft"].get("p50_ms"),
+                "itl_p50_ms": s["itl"].get("p50_ms"),
+                "itl_p99_ms": s["itl"].get("p99_ms"),
+                "shed_rate": round(shed / n_req, 3),
+                "mean_occupancy": s["mean_occupancy"]}
+
+    base = leg(0)
+    by_k = {f"k{k}": leg(k) for k in ks}
+    best_key, best = max(by_k.items(),
+                         key=lambda kv: kv[1]["tokens_per_sec_per_chip"])
+    accept = {
+        # the ISSUE 16 acceptance booleans: the drafter finds real
+        # acceptance on repetitive traffic, and speculation at the best K
+        # delivers at least the spec-off baseline's throughput
+        "spec_accepted_rate_positive": any(
+            r["accepted_token_rate"] > 0 for r in by_k.values()),
+        "spec_throughput_ge_baseline": (
+            best["tokens_per_sec_per_chip"]
+            >= base["tokens_per_sec_per_chip"]),
+        "spec_one_trace": all(r["spec_step_traces"] <= 1
+                              for r in by_k.values())}
+    return {"metric": ("serve_spec_tokens_per_sec_per_chip"
+                       if platform == "tpu"
+                       else "cpu_proxy_serve_spec_tokens_per_sec_per_chip"),
+            "value": best["tokens_per_sec_per_chip"], "unit": "tok/s/chip",
+            "vs_baseline": round(
+                best["tokens_per_sec_per_chip"]
+                / max(base["tokens_per_sec_per_chip"], 1e-9), 3),
+            "accept": accept, "best_k": int(best_key[1:]),
+            "spec_off": base, "spec_on": by_k,
+            "probe_step_ms": round(step_s * 1e3, 2),
+            "offered_rps": round(rate, 2), "load_factor": load,
+            "n_requests": n_req, "n_slots": slots, "cache_len": S,
+            "kv_block": kv_block,
+            "flash_decode": os.environ.get("FLASH_DECODE", "auto"),
+            "n_chips": n_dev, "device": jax.devices()[0].device_kind,
+            "preset": preset}
+
+
 def _serve_router_bench(platform: str) -> dict:
     """serve_load_router leg (BENCH_SERVE=1 BENCH_SERVE_ROUTER=1): the
     replicated-serving fault-tolerance A/B. Delegates to the
@@ -836,6 +1008,8 @@ def run_bench(platform: str, only_recipe: str | None = None) -> dict:
                 f"TPU probe passed but worker got {jax.default_backend()!r}"
         if os.environ.get("BENCH_PREFILL_CHUNK"):
             return _serve_chunked_bench(platform)
+        if os.environ.get("BENCH_SERVE_SPEC"):
+            return _serve_spec_bench(platform)
         return _serve_bench(platform)
 
     if os.environ.get("BENCH_DECODE"):
@@ -1144,6 +1318,12 @@ def main() -> None:
                     ("serve_load_chunked",
                      {"BENCH_SERVE": "1", "FLASH_DECODE": "on",
                       "BENCH_PREFILL_CHUNK": "128,256,512"}),
+                    # ISSUE 16: speculative decoding — greedy repetitive-
+                    # suffix traffic, BENCH_SPEC_K sweep vs the spec-off
+                    # baseline under identical seeded arrivals
+                    ("serve_load_spec",
+                     {"BENCH_SERVE": "1", "BENCH_SERVE_SPEC": "1",
+                      "FLASH_DECODE": "on", "BENCH_SPEC_K": "2,4"}),
                     # PR 8: replicated serving behind the fault-tolerant
                     # router — 3 replica processes, one SIGKILLed
                     # mid-Poisson-drive and replaced; zero-failed /

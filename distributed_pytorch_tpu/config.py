@@ -132,6 +132,13 @@ register_knob("QUANT_KV", "auto",
 register_knob("QUANT_W", "auto",
               lambda s: _onoff(s) if s.strip() else "auto",
               "int8 weight-matmul gate")
+register_knob("SPEC_DECODE", "auto",
+              lambda s: _onoff(s) if s.strip() else "auto",
+              "self-speculative decoding gate (engine/decode.py; greedy "
+              "engines only — temperature>0 falls back to the plain step)")
+register_knob("SPEC_K", "4", lambda s: int(s) if s.strip() else 4,
+              "speculative draft length: tokens the n-gram drafter "
+              "proposes per step (verify runs K+1 positions)")
 
 # --- observability / fault injection ---
 register_knob("TRACE", "on",

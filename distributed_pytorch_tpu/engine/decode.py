@@ -61,6 +61,25 @@ thousands of requests share a system prompt:
   registered in the radix index, so the requeued resume re-admits with a
   prefix hit and only the tail left to chunk in. `prefill_chunk=0` keeps
   the legacy all-or-nothing wave path (the A/B baseline).
+* **Self-speculative decoding** (`SPEC_DECODE=auto|on|off`, `SPEC_K`;
+  round 16): decode is bandwidth-bound — every step reads the full
+  weights to emit ONE token per slot. The spec step amortizes that read:
+  a host-side n-gram / prompt-lookup drafter (`ngram_propose`) proposes
+  up to K tokens per live slot from the slot's own emitted history +
+  prompt, and ONE jitted verify program (`make_spec_step_fn`) runs the
+  K+1-token cached forward for every slot at once, accepts the longest
+  draft prefix matching the model's own greedy argmax, and emits one
+  free correction token past it — exact acceptance, so greedy output is
+  bit-identical to the plain step (pinned in tests/test_spec_decode.py).
+  Accepted tokens advance `pos` and the paged cache by a variable
+  per-slot stride (`paged_update`'s multi-row branch); rejected tails
+  roll back nothing — their rows sit past the new position, causally
+  masked and overwritten before they could ever be attended, exactly
+  like the slot cache's retired rows. Draft buffers are fixed (n_slots,
+  K) traces with per-slot validity lengths TRACED, so any draft mix
+  shares one compiled program. Greedy only: temperature>0 falls back to
+  the plain step (acceptance compares argmax, which would change the
+  sampling distribution).
 
 Host/device split as before: sampling, cache writes, and positions are
 device-side; the allocator, radix index, and retirement logic are plain
@@ -203,6 +222,79 @@ def make_admit_fn(model, sample_fn, *, on_trace=None):
     return admit
 
 
+def ngram_propose(tokens, k: int, *, min_match: int = 2,
+                  max_match: int = 4) -> list:
+    """Host-side n-gram / prompt-lookup drafter: find the most recent
+    earlier occurrence of the sequence's current suffix n-gram (longest
+    match first, n in [min_match, max_match]) and propose the up-to-k
+    tokens that followed it. Pure Python over the slot's token list — no
+    device work, no model — so a draft costs microseconds against a
+    step's milliseconds. Returns [] on a miss (the slot rides the verify
+    step with draft_len 0, emitting exactly the plain step's token)."""
+    L = len(tokens)
+    if k <= 0 or L < min_match + 1:
+        return []
+    for n in range(min(max_match, L - 1), min_match - 1, -1):
+        pattern = tokens[L - n:]
+        for i in range(L - n - 1, -1, -1):
+            if tokens[i:i + n] == pattern:
+                cont = tokens[i + n:i + n + k]
+                if cont:
+                    return [int(t) for t in cont]
+                break  # suffix-adjacent match with nothing after it
+    return []
+
+
+def make_spec_step_fn(model, sample_fn, spec_k: int, *, on_trace=None):
+    """Speculative verify step: ONE program scores every live slot's
+    committed token + K draft tokens in a single K+1-position cached
+    forward (the batched generalization of the chunk forward), computes
+    each slot's accept length — the longest draft prefix where the
+    model's own greedy argmax equals the draft — and emits the free
+    correction token at the first mismatch (or the bonus position when
+    the whole draft holds). The draft buffer is a fixed (n_slots, K)
+    shape; per-slot validity lengths are TRACED, so every draft mix
+    shares this single trace. KV rows for all K+1 positions are written
+    through `paged_update`'s multi-row branch BEFORE attention (write-
+    then-attend, as everywhere else); rows past a slot's accepted length
+    are rejected-tail garbage at positions the causal mask hides until
+    later steps overwrite them — no rollback needed."""
+    K = spec_k
+
+    def spec_step(variables, caches, tok, pos, live, bt, rng, t, qparams,
+                  draft, draft_len):
+        if on_trace is not None:
+            on_trace()  # trace-time side effect
+        from distributed_pytorch_tpu.ops.quant import use_quantized_params
+        seq = jnp.concatenate([tok[:, None], draft], axis=1)  # (B, K+1)
+        with use_quantized_params(qparams):
+            logits, _, caches = model.apply(
+                variables, seq, None, caches, pos, deterministic=True,
+                block_tables=bt, all_logits=True)          # (B, K+1, V)
+        B = seq.shape[0]
+        V = logits.shape[-1]
+        # greedy targets at every position, through the SAME sample_fn as
+        # the plain step (argmax at temperature 0 — rng is ignored, so
+        # the fold_in choice cannot perturb parity)
+        g = sample_fn(logits.reshape(B * (K + 1), V),
+                      jax.random.fold_in(rng, t)).reshape(B, K + 1)
+        # accept length: longest draft prefix matching the targets,
+        # masked to each slot's valid draft length
+        valid = jnp.arange(K)[None, :] < draft_len[:, None]
+        match = (draft == g[:, :K]) & valid
+        acc = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+        # the correction token: the target right past the accepted prefix
+        nxt = jnp.take_along_axis(g, acc[:, None], axis=1)[:, 0]
+        # dead slots freeze token/pos and report 0 accepted (their table
+        # rows are zeroed, so the K+1 writes landed in the null block)
+        nxt = jnp.where(live, nxt, tok)
+        acc = jnp.where(live, acc, 0)
+        pos = pos + jnp.where(live, acc + 1, 0)
+        return caches, nxt, pos, acc
+
+    return spec_step
+
+
 def prefill_bucket_for(prompt_len: int, min_bucket: int, block_size: int,
                        max_len: int) -> int:
     """The pow2 bucket a (suffix of this length's) prefill runs in —
@@ -231,19 +323,25 @@ def enumerate_prefill_buckets(min_bucket: int, block_size: int,
 
 
 def enumerate_trace_signatures(*, min_bucket: int, block_size: int,
-                               max_len: int, prefill_chunk: int) -> dict:
+                               max_len: int, prefill_chunk: int,
+                               spec_k: int = 0) -> dict:
     """Statically enumerate the distinct compiled-program signatures one
     engine configuration can legitimately build, keyed by trace-guard
     family (obs/retrace.py). Chunked mode fuses prefill into the decode
     step (one fused_step program, plus the chunk-free plain step), so its
     admit count is 0 for ANY prompt mix; wave mode compiles one admit per
-    pow2 bucket. parallel/commscheck.py asserts these counts against the
-    engine's TraceGuard budgets at lint time."""
+    pow2 bucket. Speculative decoding (spec_k > 0) adds exactly ONE
+    spec_step program: the draft buffer is a fixed (n_slots, K) shape
+    and validity lengths are traced, so every draft mix — including the
+    all-miss mix — shares it. parallel/commscheck.py asserts these
+    counts against the engine's TraceGuard budgets at lint time."""
     buckets = enumerate_prefill_buckets(min_bucket, block_size, max_len)
+    spec = 1 if spec_k else 0
     if prefill_chunk:
-        return {"step": 1, "fused_step": 1, "admit": 0, "buckets": []}
+        return {"step": 1, "fused_step": 1, "admit": 0,
+                "spec_step": spec, "buckets": []}
     return {"step": 1, "fused_step": 0, "admit": len(buckets),
-            "buckets": buckets}
+            "spec_step": spec, "buckets": buckets}
 
 
 @dataclasses.dataclass
@@ -278,17 +376,25 @@ class Admission:
 @dataclasses.dataclass
 class StepResult:
     """One fused step's host-visible output: `emitted` maps every sequence
-    that advanced this step to the token it sampled — including a
-    sequence whose final prefill chunk ran this step (its entry is the
-    first sampled token); `retired` holds the sequences that finished,
-    including any preempted BEFORE the step ran (those emit no token).
-    `prefill_tokens` is the chunk work fused into this step (0 on pure
-    decode steps and in wave mode) — the scheduler feeds it to the
-    `prefill_tokens_per_step` histogram."""
+    that advanced this step to the LIST of tokens it emitted, in stream
+    order — one token on a plain step (including a sequence whose final
+    prefill chunk ran this step: its entry is the first sampled token),
+    up to K+1 on a speculative step (accepted draft prefix + the
+    correction token, truncated at EOS); `retired` holds the sequences
+    that finished, including any preempted BEFORE the step ran (those
+    emit no token). `prefill_tokens` is the chunk work fused into this
+    step (0 on pure decode steps and in wave mode) — the scheduler feeds
+    it to the `prefill_tokens_per_step` histogram. `drafted`/`accepted`
+    count this step's speculative proposals and how many of them the
+    verify accepted (both 0 on non-spec steps) — the scheduler's
+    spec_drafted_tokens/spec_accepted_tokens counters and the flight
+    ring's per-step acceptance view read these."""
 
     emitted: dict
     retired: dict
     prefill_tokens: int = 0
+    drafted: int = 0
+    accepted: int = 0
 
 
 @dataclasses.dataclass
@@ -357,6 +463,8 @@ class DecodeEngine:
                  n_blocks: Optional[int] = None,
                  prefix_cache: bool = True,
                  prefill_chunk: int = 0,
+                 spec_decode: Optional[bool] = None,
+                 spec_k: Optional[int] = None,
                  flight_capacity: int = 4096):
         cfg = model.config
         self.model = model
@@ -383,6 +491,17 @@ class DecodeEngine:
         self.top_k = top_k
         self.eos_id = eos_id
         self.min_bucket = min_bucket
+        # speculative decoding (module docstring): SPEC_DECODE=auto defers
+        # to the constructor request, on/off overrides it — the same
+        # resolve_gate contract as the quant knobs. Greedy only: the
+        # verify compares argmax targets, so any temperature>0 engine
+        # silently keeps the plain step regardless of the gate.
+        from distributed_pytorch_tpu.config import knob
+        k = spec_k if spec_k is not None else knob("SPEC_K")
+        self.spec_k = max(int(k), 0)
+        self.spec_decode = (quant.resolve_gate(knob("SPEC_DECODE"),
+                                               bool(spec_decode))
+                            and self.spec_k > 0 and temperature == 0.0)
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         self._mesh = mesh
         self._recipe = recipe
@@ -481,6 +600,7 @@ class DecodeEngine:
         self._donate = (1,) if jax.default_backend() == "tpu" else ()
         self._step_fn = None
         self._fused_step_fn = None
+        self._spec_step_fn = None
         self._admit_fns: dict[int, Any] = {}
         # retrace guards (obs/retrace.py): each compiled family budgets
         # its legitimate trace count — step/fused_step trace ONCE for any
@@ -491,6 +611,9 @@ class DecodeEngine:
             "step": TraceGuard("engine.step"),
             "fused_step": TraceGuard("engine.fused_step"),
             "admit": TraceGuard("engine.admit", budget=0),
+            "spec_step": TraceGuard(
+                "engine.spec_step",
+                budget=1 if self.spec_decode else 0),
         }
         self.admit_traces: dict[int, int] = {}  # bucket -> trace count
         # lifetime counters — the stable occupancy/accounting surface a
@@ -501,6 +624,10 @@ class DecodeEngine:
         self.prompt_tokens = 0        # prompt tokens across admissions
         self.prefix_hit_tokens = 0    # of those, served from cached blocks
         self.prefilled_tokens = 0     # suffix tokens actually prefilled
+        # speculative-decoding accounting (bench + /metrics read these)
+        self.spec_drafted_tokens = 0  # drafter proposals sent to verify
+        self.spec_accepted_tokens = 0  # of those, accepted by the target
+        self.emitted_tokens = 0       # tokens emitted across all steps
         # step-level flight recorder (obs/flight.py): one record per
         # fused step in a bounded ring — the /debug/timeline payload and
         # the runs/*.jsonl post-hoc artifact
@@ -550,6 +677,15 @@ class DecodeEngine:
                                       donate_argnums=self._donate)
         return self._fused_step_fn
 
+    def _get_spec_step_fn(self):
+        if self._spec_step_fn is not None:
+            return self._spec_step_fn
+        spec = make_spec_step_fn(
+            self.model, self._sample, self.spec_k,
+            on_trace=self.trace_guards["spec_step"].mark)
+        self._spec_step_fn = jax.jit(spec, donate_argnums=self._donate)
+        return self._spec_step_fn
+
     def _get_admit_fn(self, bucket: int):
         fn = self._admit_fns.get(bucket)
         if fn is not None:
@@ -578,6 +714,22 @@ class DecodeEngine:
     @property
     def fused_step_traces(self) -> int:
         return self.trace_guards["fused_step"].count
+
+    @property
+    def spec_step_traces(self) -> int:
+        return self.trace_guards["spec_step"].count
+
+    @property
+    def accepted_token_rate(self) -> float:
+        """Lifetime fraction of drafted tokens the verify accepted."""
+        return (self.spec_accepted_tokens / self.spec_drafted_tokens
+                if self.spec_drafted_tokens else 0.0)
+
+    @property
+    def tokens_per_step(self) -> float:
+        """Lifetime mean tokens emitted per fused step — the speculative
+        multiplier on step throughput (1.0 when spec is off or missing)."""
+        return self.emitted_tokens / self._t if self._t else 0.0
 
     @property
     def free_slots(self) -> list[int]:
@@ -929,11 +1081,56 @@ class DecodeEngine:
             self._rebuild_live()
         return preempted
 
+    def _spec_drafts(self) -> Optional[tuple]:
+        """Host-side drafting for one speculative step: an (n_slots, K)
+        draft buffer + per-slot validity lengths, or None when this step
+        must run the plain program. Clamps each slot's draft so the
+        emitted run (accepted + correction) can never overshoot its
+        budget or the cache (`n <= max_new - n_new - 1`,
+        `n <= max_len - pos - 1`), grows block lists to cover the deepest
+        acceptable row — SHRINKING the draft instead of preempting when
+        the pool runs dry, speculation must never evict live work — and
+        falls back entirely when any live slot sits too close to the
+        position-table end: `slice_rows`' (B,) dynamic_slice start clamps
+        near the boundary, which would mis-rotate ALL K+1 rows of that
+        slot (the committed write included). Such slots retire within K
+        steps anyway, so the fallback window is brief."""
+        K = self.spec_k
+        draft = np.zeros((self.n_slots, K), np.int32)
+        dlen = np.zeros((self.n_slots,), np.int32)
+        any_draft = False
+        for slot in self._live_slots():
+            seq = self._slots[slot]
+            if seq.pos + K + 1 > self.max_len:
+                return None              # rope-table clamp hazard
+            prop = ngram_propose(seq.tokens, K)
+            n = min(len(prop), seq.max_new - seq.n_new - 1,
+                    self.max_len - seq.pos - 1)
+            while n > 0 and \
+                    seq.pos + n >= len(seq.blocks) * self.block_size:
+                blk = self.block_pool.alloc()
+                if blk is None:
+                    n = len(seq.blocks) * self.block_size - seq.pos - 1
+                    break
+                self._tables_h[slot, len(seq.blocks)] = blk
+                seq.blocks.append(blk)
+                self._tables_dirty = True
+            if n <= 0:
+                continue
+            draft[slot, :n] = prop[:n]
+            dlen[slot] = n
+            any_draft = True
+        if not any_draft:
+            return None                  # nothing to verify: plain step
+        return draft, dlen
+
     def step(self) -> StepResult:
-        """Advance every live slot one token, fusing in one prefill chunk
-        of the oldest partial prompt when `prefill_chunk` is set. Returns
-        a `StepResult`: {seq_id: token} sampled this step (including the
-        first token of a prompt whose LAST chunk ran), plus
+        """Advance every live slot one token — or, on a speculative step
+        (`spec_decode` on, drafts available), up to `spec_k`+1 tokens —
+        fusing in one prefill chunk of the oldest partial prompt when
+        `prefill_chunk` is set. Returns a `StepResult`:
+        {seq_id: [tokens]} emitted this step in stream order (including
+        the first token of a prompt whose LAST chunk ran), plus
         {seq_id: Retired} for the sequences that finished (with WHY —
         eos | budget | cache_full | preempted; preempted ones yielded
         their blocks BEFORE the step and emit no token — requeue
@@ -946,6 +1143,12 @@ class DecodeEngine:
         if not self._slots or (chunk is None and not self._live_slots()):
             return StepResult({}, preempted)
         n_live_in = len(self._live_slots())    # decoding slots this step
+        # speculative drafting happens BEFORE the table sync (it may grow
+        # block lists to cover accepted rows); a chunked step never
+        # speculates — the chunk already owns the step's spare compute
+        spec = None
+        if self.spec_decode and chunk is None:
+            spec = self._spec_drafts()
         self._sync_tables()
         chunk_done = False
         if chunk is not None:
@@ -964,6 +1167,15 @@ class DecodeEngine:
                     jnp.int32(slot_c), jnp.int32(off),
                     jnp.asarray([take], jnp.int32), jnp.bool_(chunk_done))
             self.caches, self.tok, self.pos, self.live = out
+        elif spec is not None:
+            draft_h, dlen_h = spec
+            with self._ctx():
+                out = self._get_spec_step_fn()(
+                    self.variables, self.caches, self.tok, self.pos,
+                    self.live, self.block_tables, self._rng,
+                    jnp.int32(self._t), self._qparams,
+                    jnp.asarray(draft_h), jnp.asarray(dlen_h))
+            self.caches, self.tok, self.pos, acc_dev = out
         else:
             with self._ctx():
                 self.caches, self.tok, self.pos = self._get_step_fn()(
@@ -972,11 +1184,17 @@ class DecodeEngine:
                     jnp.int32(self._t), self._qparams)
         self._t += 1
         # THE step sync boundary: every slot's sampled token drains to the
-        # host once per fused step
-        sampled = jax.device_get(self.tok)  # lint: allow(host-sync)
-        emitted: dict[int, int] = {}
+        # host once per fused step (plus the per-slot accept lengths on a
+        # speculative step — one transfer, not two)
+        if spec is not None:
+            sampled, accepted_h = \
+                jax.device_get((self.tok, acc_dev))  # lint: allow(host-sync)
+        else:
+            sampled = jax.device_get(self.tok)  # lint: allow(host-sync)
+        emitted: dict[int, list] = {}
         retired: dict[int, Retired] = dict(preempted)
         prefill_tokens = 0
+        drafted = accepted = 0
         if chunk is not None:
             # host mirror of the chunk: progress the partial, publish the
             # blocks that just became full+immutable into the radix index
@@ -1006,29 +1224,55 @@ class DecodeEngine:
                 continue                       # still parked: no token
             nxt = int(sampled[slot])
             if chunk is not None and slot == slot_c and chunk_done:
-                pass                           # bookkeeping done above
+                toks = [nxt]                   # bookkeeping done above
+            elif spec is not None:
+                # accepted draft prefix + the correction token, in
+                # stream order. EOS inside the accepted span ends the
+                # stream AT the EOS token: everything past it is dropped
+                # (the device pos runs ahead, but the slot retires this
+                # step and its zeroed table row makes the overshoot
+                # unreachable — the next occupant rewrites those rows
+                # before they could ever be attended)
+                acc_s = int(accepted_h[slot])
+                toks = [int(draft_h[slot, j])
+                        for j in range(acc_s)] + [nxt]
+                if self.eos_id is not None and self.eos_id in toks:
+                    toks = toks[:toks.index(self.eos_id) + 1]
+                seq.tokens.extend(toks)
+                seq.n_new += len(toks)
+                seq.pos += len(toks)
+                accepted += acc_s
             else:
+                toks = [nxt]
                 seq.tokens.append(nxt)
                 seq.n_new += 1
                 seq.pos += 1
-            emitted[seq.seq_id] = nxt
-            reason = self._retire_reason(slot, nxt)
+            emitted[seq.seq_id] = toks
+            reason = self._retire_reason(slot, toks[-1])
             if reason is not None:
                 retired[seq.seq_id] = self._retire(slot, reason)
         # drop retired slots from the live mask (their table rows are
         # zeroed, so any residual write lands in the null block)
         if len(retired) > len(preempted):
             self._rebuild_live()
+        n_emitted = sum(len(v) for v in emitted.values())
+        self.emitted_tokens += n_emitted
+        if spec is not None:
+            drafted = int(dlen_h.sum())
+            self.spec_drafted_tokens += drafted
+            self.spec_accepted_tokens += accepted
         self.flight.record(
             step=self._t,
             step_ms=round((time.perf_counter() - t_step0) * 1e3, 3),
             n_live=n_live_in, prefill_tokens=prefill_tokens,
-            emitted=len(emitted),
+            emitted=n_emitted,
             retired=len(retired) - len(preempted),
             blocks_in_use=self.block_pool.n_referenced,
-            preemptions=len(preempted))
+            preemptions=len(preempted),
+            drafted=drafted, accepted=accepted)
         return StepResult(emitted=emitted, retired=retired,
-                          prefill_tokens=prefill_tokens)
+                          prefill_tokens=prefill_tokens,
+                          drafted=drafted, accepted=accepted)
 
     def run(self, prompts, max_new_tokens,
             progress=None) -> list[list]:

@@ -113,13 +113,15 @@ class LLM(nn.Module):
     @nn.compact
     def __call__(self, idx, targets=None, caches=None, pos=0, *,
                  deterministic: bool = True, logits_idx=None,
-                 block_tables=None):
+                 block_tables=None, all_logits: bool = False):
         """`pos` is the global position of idx[:, 0] — a static int, a
         traced scalar, or a per-sequence (B,) array (slot-based ragged
         decode; each sequence in the batch sits at its own cache
         position). `logits_idx` (B,) selects which position's logits to
         return when targets is None (default: the last) — the bucketed
-        prefill path, where right-padded prompts end at different rows.
+        prefill path, where right-padded prompts end at different rows;
+        `all_logits=True` returns every position's logits instead (the
+        speculative verify step scores all K+1 draft positions at once).
         `block_tables` (B, max_blocks) int32 marks the caches as PAGED
         pools (init_paged_cache); reads and writes then indirect through
         the table (ops/block_pool.py)."""
@@ -261,7 +263,9 @@ class LLM(nn.Module):
             # dead-code-eliminates this matmul.
             logits = tkn_emb.attend(x)
         else:
-            if logits_idx is None:
+            if all_logits:
+                sel = x                            # every position (verify)
+            elif logits_idx is None:
                 sel = x[:, -1:, :]                 # last position only (:694)
             else:
                 # bucketed prefill: each sequence's true last token sits at

@@ -162,6 +162,25 @@ def _analyze_engine(paths: list[str]) -> Optional[dict]:
             "warmup_excluded": warmup,
         },
     }
+    # speculative-decoding term (round 20): a spec step delivers
+    # accepted+1 tokens per weight read, so the per-TOKEN latency is the
+    # step cost divided by tokens delivered — ITL ≈ step / tokens_accepted
+    spec_steps = [r for r in recs if int(r.get("drafted", 0)) > 0]
+    if spec_steps:
+        drafted = sum(int(r["drafted"]) for r in spec_steps)
+        accepted = sum(int(r.get("accepted", 0)) for r in spec_steps)
+        tps = [float(r.get("emitted", 0)) / max(float(r.get("n_live", 1)),
+                                                1.0)
+               for r in spec_steps]
+        mean_tps = sum(tps) / len(tps)
+        out["spec_model"] = {
+            "spec_steps": len(spec_steps),
+            "drafted": drafted,
+            "accepted": accepted,
+            "accepted_token_rate": round(accepted / max(drafted, 1), 4),
+            "tokens_per_step_per_slot": dist(tps, nd=3),
+            "itl_ms_per_token": round(a / max(mean_tps, 1e-9), 4),
+        }
     return out
 
 
@@ -302,6 +321,15 @@ def _render_md(a: dict) -> str:
               " · prefill_tokens`  —  median abs error "
               f"{m['mae_pct']}% over {m['n_fit']} steps.", "",
               "### Distributions", ""]
+        sm = eng.get("spec_model")
+        if sm:
+            L += ["### Speculative decoding "
+                  "(ITL ≈ step / tokens_accepted)", "",
+                  f"{sm['spec_steps']} spec step(s); accepted "
+                  f"{sm['accepted']}/{sm['drafted']} drafted tokens "
+                  f"(rate {sm['accepted_token_rate']}); effective "
+                  f"`ITL ≈ {sm['itl_ms_per_token']} ms/token` at the "
+                  "fitted step floor.", ""]
         for key in ("step_ms", "decode_step_ms",
                     "prefill_tokens_per_step", "n_live"):
             if eng[key].get("n"):
@@ -348,6 +376,8 @@ def cost_model(a: dict) -> dict:
         out["engine"] = {k: eng[k] for k in
                          ("step_model", "step_ms", "decode_step_ms",
                           "prefill_tokens_per_step", "n_live")}
+        if "spec_model" in eng:
+            out["engine"]["spec_model"] = eng["spec_model"]
     tr = a.get("trace")
     if tr:
         out["phases"] = tr["phases"]

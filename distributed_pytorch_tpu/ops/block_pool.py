@@ -75,15 +75,22 @@ def paged_update(pool: jnp.ndarray, new: jnp.ndarray, pos,
     logical positions [pos, pos+T) of each sequence, addressed through
     `block_tables` (B, max_blocks) int32.
 
-    Two shapes, mirroring `_update_cache`'s prefill/decode split:
+    Three shapes, mirroring `_update_cache`'s prefill/decode split plus
+    the spec-verify short window:
     * T == 1 (fused decode step): `pos` is per-sequence (B,); one 2-index
       scatter writes every live slot's row. Tail blocks are never shared,
       so concurrent writers cannot collide (dead slots all land in the
       null block — harmless, nothing reads it).
-    * T > 1 (bucketed prefill): B == 1, `pos` a block-aligned scalar (the
-      reused-prefix length), T a multiple of the block size; whole blocks
-      are scattered in one shot. Pad rows land in blocks private to this
-      sequence and are causally masked exactly as in the slot cache.
+    * T > 1 with per-sequence (B,) `pos` (speculative verify): each slot
+      writes T = K+1 consecutive rows starting at its own offset. The
+      window is unrolled into T per-slot scatters; a row whose table
+      index would run off the table routes to the null block, so the
+      traced program is safe for any pos without a bounds retrace.
+    * T > 1 with scalar `pos` (bucketed prefill): B == 1, `pos`
+      block-aligned (the reused-prefix length), T a multiple of the block
+      size; whole blocks are scattered in one shot. Pad rows land in
+      blocks private to this sequence and are causally masked exactly as
+      in the slot cache.
     """
     new = new.astype(pool.dtype)
     B, T = new.shape[:2]
@@ -93,6 +100,18 @@ def paged_update(pool: jnp.ndarray, new: jnp.ndarray, pos,
         blk = jnp.take_along_axis(block_tables, (p // bs)[:, None],
                                   axis=1)[:, 0]
         return pool.at[blk, p % bs].set(new[:, 0], mode="drop")
+    if jnp.asarray(pos).ndim >= 1:
+        # spec-verify window: per-slot start offsets, T small (K+1)
+        p = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (B,))
+        W = block_tables.shape[1]
+        for i in range(T):
+            pi = p + i
+            q = pi // bs
+            blk = jnp.take_along_axis(
+                block_tables, jnp.minimum(q, W - 1)[:, None], axis=1)[:, 0]
+            blk = jnp.where(q < W, blk, NULL_BLOCK)
+            pool = pool.at[blk, pi % bs].set(new[:, i], mode="drop")
+        return pool
     assert B == 1, "paged prefill writes one sequence at a time"
     assert T % bs == 0, f"prefill length {T} not a multiple of block {bs}"
     p0 = jnp.asarray(pos, jnp.int32).reshape(())

@@ -130,6 +130,7 @@ ENGINE_SLOTS = 8
 ENGINE_MIN_BUCKET = 16
 ENGINE_BLOCK = 16
 ENGINE_CHUNK = 64
+ENGINE_SPEC_K = 4   # speculative draft length audited (SPEC_K default)
 
 
 @dataclasses.dataclass
@@ -547,7 +548,7 @@ def audit_decode_cell(preset: str, cfg: LLMConfig, recipe: str,
     chunk = ENGINE_CHUNK if chunked else 0
     sigs = eng.enumerate_trace_signatures(
         min_bucket=ENGINE_MIN_BUCKET, block_size=ENGINE_BLOCK,
-        max_len=max_len, prefill_chunk=chunk)
+        max_len=max_len, prefill_chunk=chunk, spec_k=ENGINE_SPEC_K)
     # cross-check the closed-form bucket set against a brute-force sweep
     # of every admissible prompt length: a bucketing bug that compiles
     # per-length programs (the classic trace explosion) must fail HERE,
@@ -555,7 +556,7 @@ def audit_decode_cell(preset: str, cfg: LLMConfig, recipe: str,
     brute = sorted({eng.prefill_bucket_for(n, ENGINE_MIN_BUCKET,
                                            ENGINE_BLOCK, max_len)
                     for n in range(1, max_len + 1)})
-    budgets = {"step": 1, "fused_step": 1,
+    budgets = {"step": 1, "fused_step": 1, "spec_step": 1,
                "admit": len(brute) if not chunked else 0}
     report.signatures = {"enumerated": sigs, "budgets": budgets,
                          "brute_force_buckets": len(brute)}
@@ -564,7 +565,7 @@ def audit_decode_cell(preset: str, cfg: LLMConfig, recipe: str,
             "signature-enumeration", "error", "signatures", "admit",
             f"closed-form bucket set {sigs['buckets']} != brute-force "
             f"sweep over prompt lengths ({len(brute)} buckets)"))
-    for fam in ("step", "fused_step", "admit"):
+    for fam in ("step", "fused_step", "admit", "spec_step"):
         if sigs[fam] > budgets[fam]:
             report.findings.append(Finding(
                 "trace-budget", "error", "signatures", fam,
@@ -608,6 +609,20 @@ def audit_decode_cell(preset: str, cfg: LLMConfig, recipe: str,
         don = donation_report(step_tr)
         report.donation["step"] = don
         _donation_findings(report, "step", don)
+        # spec-verify program (ISSUE 16): same forward as the step but
+        # K+1 positions wide — must add NO collectives beyond the step's
+        # own (the single-chip unexpected-comms check covers it below)
+        draft = jax.ShapeDtypeStruct((n_slots, ENGINE_SPEC_K), i32)
+        dlen = jax.ShapeDtypeStruct((n_slots,), i32)
+        spec_tr = jax.jit(
+            eng.make_spec_step_fn(model, sample, ENGINE_SPEC_K),
+            donate_argnums=(1,)).trace(
+            var_shapes, caches, tok, pos, live, bt, rng, t, None,
+            draft, dlen)
+        inv += collective_inventory(spec_tr)
+        don = donation_report(spec_tr)
+        report.donation["spec_step"] = don
+        _donation_findings(report, "spec_step", don)
         if chunked:
             ctoks = jax.ShapeDtypeStruct((1, chunk), i32)
             clen = jax.ShapeDtypeStruct((1,), i32)
@@ -859,6 +874,7 @@ def format_report(r: CommsReport) -> str:
         sig = r.signatures["enumerated"]
         lines.append(f"  signatures: step={sig['step']} "
                      f"fused={sig['fused_step']} admit={sig['admit']} "
+                     f"spec={sig.get('spec_step', 0)} "
                      f"(budgets {r.signatures['budgets']})")
     for f in r.findings:
         lines.append(f"  [{f.severity.upper()}] {f.rule} "

@@ -327,9 +327,14 @@ class ServeMetrics:
     #: tokens; 'failed' counts requests terminated by an engine error —
     #: the denominator term of the availability SLO that neither
     #: 'completed' nor 'shed' covers.
+    #: 'spec_drafted_tokens'/'spec_accepted_tokens' are the speculative-
+    #: decoding ledger (engine/decode.py): tokens the n-gram drafter
+    #: proposed vs tokens the verify step accepted — their ratio is the
+    #: accepted_token_rate gauge the spec bench leg pins.
     COUNTERS = ("submitted", "admitted", "completed", "cancelled", "shed",
                 "failed", "tokens_out", "preempted", "requeued",
-                "prefix_hit_tokens", "prefix_miss_tokens")
+                "prefix_hit_tokens", "prefix_miss_tokens",
+                "spec_drafted_tokens", "spec_accepted_tokens")
 
     def __init__(self):
         self._gauges: dict[str, tuple[Callable[[], float], str]] = {}
@@ -461,6 +466,13 @@ class ServeMetrics:
                   f"{self.counters['prefix_hit_tokens']}",
                   f'serve_prefix_tokens_total{{kind="miss"}} '
                   f"{self.counters['prefix_miss_tokens']}"]
+        lines += ["# HELP serve_spec_tokens_total speculative decoding: "
+                  "draft tokens proposed vs accepted by the verify step",
+                  "# TYPE serve_spec_tokens_total counter",
+                  f'serve_spec_tokens_total{{kind="drafted"}} '
+                  f"{self.counters['spec_drafted_tokens']}",
+                  f'serve_spec_tokens_total{{kind="accepted"}} '
+                  f"{self.counters['spec_accepted_tokens']}"]
         for cause, n in sorted(self.shed_counts.items()):
             lines.append(f'serve_shed_total{{cause="{cause}"}} {n}')
         for reason, n in sorted(self.retire_counts.items()):

@@ -269,6 +269,17 @@ class Scheduler:
             "serve_engine_retrace_excess",
             lambda: sum(g.excess for g in self.engine.trace_guards.values()),
             "engine traces past budget — should be 0")
+        # speculative decoding (engine/decode.py): what fraction of
+        # drafted tokens the verify step accepted, and how many tokens
+        # each fused step delivered on average (1.0 with spec off)
+        self.metrics.register_gauge(
+            "serve_spec_accepted_token_rate",
+            lambda: getattr(self.engine, "accepted_token_rate", 0.0),
+            "accepted/drafted fraction of speculative draft tokens")
+        self.metrics.register_gauge(
+            "serve_engine_tokens_per_step",
+            lambda: getattr(self.engine, "tokens_per_step", 1.0),
+            "mean tokens emitted per fused step (spec decode > 1)")
         # provenance: the engine's serving-relevant config as a
         # Prometheus info gauge (and in the bench JSON via summary())
         self.metrics.set_build_info(**engine_build_info(engine))
@@ -667,11 +678,22 @@ class Scheduler:
                     # signal (p50 ~ budget => prefill-bound, ~0 => slack)
                     self.metrics.prefill_tokens_per_step.observe(
                         res.prefill_tokens)
-                for sid, tok in res.emitted.items():
+                if res.drafted:
+                    # speculative-decoding ledger: acceptance rate is
+                    # accepted/drafted; the spec bench leg pins it > 0
+                    self.metrics.inc("spec_drafted_tokens", res.drafted)
+                    self.metrics.inc("spec_accepted_tokens", res.accepted)
+                for sid, toks in res.emitted.items():
                     req = self._live.get(sid)
                     if req is None:            # cancelled mid-flight
                         continue
-                    self._emit_token(req, tok, now)
+                    # a spec step emits a LIST (accepted prefix + the
+                    # correction token); fanning them out one at a time
+                    # preserves stream order and the served-count/TTFT
+                    # bookkeeping (first-ever token is still the TTFT;
+                    # later tokens in the same step are ~0 ITL samples)
+                    for tok in toks:
+                        self._emit_token(req, tok, now)
                 requeued: list[_Request] = []
                 for sid, ret in res.retired.items():
                     req = self._live.pop(sid, None)

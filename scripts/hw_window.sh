@@ -5,10 +5,13 @@
 #   1. bench.py            — the headline MFU number (its mini-sweep already
 #                            A/Bs flash/slab/streaming-CE legs plus the
 #                            decode/serve bundle: flash-vs-naive, int8,
-#                            paged-prefix serve_load_prefix, and the
-#                            round-12 serve_load_chunked chunk-size sweep
-#                            — BENCH_PREFILL_CHUNK 128/256/512 vs the wave
-#                            baseline; worst case ~75 min if the tunnel
+#                            paged-prefix serve_load_prefix, the round-12
+#                            serve_load_chunked chunk-size sweep —
+#                            BENCH_PREFILL_CHUNK 128/256/512 vs the wave
+#                            baseline — and the round-20 serve_load_spec
+#                            leg: speculative decoding BENCH_SPEC_K 2/4
+#                            vs the spec-off baseline on the same seeded
+#                            arrivals; worst case ~75 min if the tunnel
 #                            goes half-up mid-bench, so the cap is 90 min —
 #                            bench always prints its JSON line if allowed
 #                            to finish)

@@ -29,11 +29,11 @@ smoke_one() {  # $@ = extra server args
 
   echo "--- SSE stream ---"
   curl -sN -X POST "http://127.0.0.1:$PORT/v1/completions" \
-    -d '{"prompt": [1, 2, 3], "max_tokens": 8}'
+    -d "{\"prompt\": ${PROMPT_JSON:-[1, 2, 3]}, \"max_tokens\": 8}"
 
   echo "--- /metrics (ttft + lifecycle) ---"
   curl -sf "http://127.0.0.1:$PORT/metrics" \
-    | grep -E 'serve_ttft_seconds_count|serve_requests_total|serve_slot_occupancy'
+    | grep -E "${METRICS_GREP:-serve_ttft_seconds_count|serve_requests_total|serve_slot_occupancy}"
 
   kill $SERVER_PID 2>/dev/null || true
   wait $SERVER_PID 2>/dev/null || true
@@ -45,6 +45,17 @@ smoke_one
 
 echo "=== chunked-prefill smoke (--prefill-chunk 32) ==="
 smoke_one --prefill-chunk 32
+
+# speculative-decoding leg (round 20): one greedy request with the n-gram
+# drafter enabled, on a repetitive prompt so the drafter can fire; the
+# grep asserts the spec counters and acceptance-rate gauge are live on
+# /metrics (their VALUES depend on the random-init demo model — presence
+# plus a clean bit-exact stream is the smoke contract).
+echo "=== speculative-decoding smoke (SPEC_DECODE=on) ==="
+SPEC_DECODE=on SPEC_K=4 \
+  PROMPT_JSON='[1, 2, 3, 1, 2, 3, 1, 2]' \
+  METRICS_GREP='serve_spec_tokens_total|serve_spec_accepted_token_rate' \
+  smoke_one
 
 # Router tier: 2 real replica processes behind the health-gated router,
 # one SIGKILLed mid-Poisson-drive and replaced on the same port. The

@@ -138,8 +138,8 @@ def test_cow_fork_shares_prefix_and_diverges(mv):
     done = {}
     while eng.n_live:
         res = eng.step()
-        for sid, t in res.emitted.items():
-            outs[sid].append(t)
+        for sid, toks in res.emitted.items():
+            outs[sid].extend(toks)
         done.update(res.retired)
     for p, sid in ((p1, a1.seq_id), (p2, a2.seq_id)):
         ref = generate(model, variables, jnp.asarray(p, jnp.int32)[None], 8,

@@ -1,0 +1,376 @@
+"""Speculative decoding (ISSUE 16): host n-gram drafter units, engine-vs-
+`generate` bit-parity with SPEC_DECODE on across attention flavors /
+int8 KV / prefix reuse / preemption, acceptance-length edge cases
+(0 accepted, all-K accepted, EOS inside the accepted span), the one-
+spec-trace pin, and scheduler stream ordering under multi-token
+emission. Greedy verification is exact, so every assertion here is
+bit-equality — never a tolerance."""
+
+import asyncio
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from distributed_pytorch_tpu.config import LLMConfig
+from distributed_pytorch_tpu.engine import DecodeEngine
+from distributed_pytorch_tpu.engine import decode as decode_mod
+from distributed_pytorch_tpu.engine.decode import (
+    enumerate_trace_signatures, ngram_propose)
+from distributed_pytorch_tpu.models.generate import generate
+from distributed_pytorch_tpu.models.gpt import LLM
+from distributed_pytorch_tpu.serve.scheduler import Scheduler
+
+
+def tiny_cfg(**kw):
+    base = dict(vocab_size=97, block_size=64, n_embd=48, n_head=4,
+                n_kv_heads=2, attn="gqa", n_layer=2, up_dim=64,
+                non_linearity="swiglu", pos_emb="rope", dropout=0.0,
+                q_latent_dim=16, kv_latent_dim=16, rope_head_dim=8)
+    base.update(kw)
+    return LLMConfig(**base)
+
+
+def build(cfg, seed=0, attn_impl="naive"):
+    model = LLM(cfg, attn_impl=attn_impl)
+    rng = jax.random.PRNGKey(seed)
+    x = jnp.zeros((1, cfg.block_size), jnp.int32)
+    variables = model.init({"params": rng, "dropout": rng}, x, x)
+    return model, {k: v for k, v in variables.items()}
+
+
+def spec_engine(model, variables, **kw):
+    kw.setdefault("n_slots", 2)
+    kw.setdefault("min_bucket", 8)
+    kw.setdefault("spec_k", 4)
+    return DecodeEngine(model, variables, temperature=0.0,
+                        spec_decode=True, **kw)
+
+
+def oracle(model, variables, prompt, n):
+    return generate(model, variables, jnp.asarray(prompt, jnp.int32)[None],
+                    n, temperature=0.0)[0].tolist()
+
+
+# repetitive suffixes (n-gram hits) mixed with structureless prompts
+PROMPTS = [[1, 2, 3, 1, 2, 3, 1, 2], [5, 6, 7, 8, 9, 10, 11],
+           [20] * 17, [4, 9, 4, 9, 4], [9]]
+
+
+# ----------------------------------------------------------------------
+# drafter units
+# ----------------------------------------------------------------------
+
+def test_ngram_hit_proposes_continuation():
+    # suffix [1,2] last occurred earlier at index 0, followed by 3,4,5
+    assert ngram_propose([1, 2, 3, 4, 5, 1, 2], 3) == [3, 4, 5]
+
+
+def test_ngram_prefers_longest_match():
+    # suffix [7,1,2] (n=3) matches at index 0 -> 9; the shorter [1,2]
+    # match elsewhere must not win
+    toks = [7, 1, 2, 9, 5, 1, 2, 8, 7, 1, 2]
+    assert ngram_propose(toks, 2) == [9, 5]
+
+
+def test_ngram_takes_most_recent_occurrence():
+    # suffix [1,2] occurs at 0 (-> 5) and at 3 (-> 6): most recent wins
+    assert ngram_propose([1, 2, 5, 1, 2, 6, 1, 2], 1) == [6]
+
+
+def test_ngram_miss_and_degenerate_inputs():
+    assert ngram_propose([1, 2, 3, 4, 5], 4) == []     # no repeat
+    assert ngram_propose([1, 2], 4) == []              # too short
+    assert ngram_propose([1, 2, 3, 1, 2], 0) == []     # k=0
+    assert ngram_propose([], 4) == []
+
+
+def test_ngram_clamps_to_k():
+    toks = [1, 2, 3, 4, 5, 6, 7, 1, 2]
+    assert ngram_propose(toks, 2) == [3, 4]
+    assert ngram_propose(toks, 100) == [3, 4, 5, 6, 7, 1, 2]
+
+
+# ----------------------------------------------------------------------
+# engine-vs-generate bit parity, spec on
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("kw", [
+    dict(attn="gqa", n_kv_heads=2, pos_emb="rope"),
+    dict(attn="mla", pos_emb="rope"),
+    dict(attn="mha", pos_emb="learn"),
+], ids=["gqa-rope", "mla-rope", "mha-learn"])
+def test_spec_matches_generate(kw):
+    """Ragged continuous batching with speculation on is token-identical
+    to decoding each prompt alone — accepted prefixes, correction tokens,
+    rejected-tail garbage rows and per-slot strides must all be
+    invisible."""
+    cfg = tiny_cfg(**kw)
+    model, variables = build(cfg)
+    eng = spec_engine(model, variables)
+    outs = eng.run(PROMPTS, max_new_tokens=8)
+    for p, o in zip(PROMPTS, outs):
+        assert o == oracle(model, variables, p, 8), \
+            f"spec engine diverged from generate for prompt {p}"
+    assert eng.spec_drafted_tokens > 0, "drafter never fired"
+
+
+def test_spec_matches_spec_off_engine_int8_kv():
+    """int8 KV: quantize/dequantize must round-trip identically through
+    the K+1-row verify writes — pinned engine-vs-engine (both int8), and
+    both against the bf16 spec-off run being unnecessary: int8 changes
+    logits, so the invariant is spec-on == spec-off at the SAME dtype."""
+    cfg = tiny_cfg()
+    model, variables = build(cfg)
+    on = spec_engine(model, variables, cache_dtype="int8")
+    off = DecodeEngine(model, variables, n_slots=2, temperature=0.0,
+                       min_bucket=8, cache_dtype="int8", spec_decode=False)
+    outs_on = on.run(PROMPTS, max_new_tokens=8)
+    outs_off = off.run(PROMPTS, max_new_tokens=8)
+    assert outs_on == outs_off
+    assert on.spec_drafted_tokens > 0
+
+
+def test_spec_with_prefix_reuse():
+    """Prompts resolving to cached prefix blocks still verify/accept
+    correctly (the verify window starts mid-sequence over shared
+    blocks)."""
+    cfg = tiny_cfg()
+    model, variables = build(cfg)
+    eng = spec_engine(model, variables, block_size=8)
+    shared = [3, 1, 4, 1, 5, 9, 2, 6] * 3            # 3 full 8-blocks
+    prompts = [shared + [30], shared + [40, 41]]
+    outs = eng.run(prompts, max_new_tokens=8)
+    out2 = eng.run(prompts, max_new_tokens=8)        # second pass: hits
+    for p, o in zip(prompts, outs):
+        assert o == oracle(model, variables, p, 8)
+    assert outs == out2
+    assert eng.prefix_hit_tokens > 0
+
+
+def test_spec_under_preemption():
+    """A tight pool preempts mid-decode; the resumed sequence (requeued
+    with its tokens as the new prompt) must still land bit-exact, with
+    speculation active on both sides of the preemption."""
+    cfg = tiny_cfg()
+    model, variables = build(cfg)
+    eng = spec_engine(model, variables, n_slots=2, block_size=8,
+                      n_blocks=9)                    # tight: forces preempt
+    prompts = [[1, 2, 3, 1, 2, 3, 1], [5] * 9]
+    outs = eng.run(prompts, max_new_tokens=40)
+    for p, o in zip(prompts, outs):
+        assert o == oracle(model, variables, p, 40)
+    assert eng.retire_counts["preempted"] > 0, \
+        "pool never got tight — test is vacuous"
+    assert eng.block_pool.n_referenced == 0          # nothing leaked
+
+
+def test_spec_budget_boundary_exact():
+    """The draft clamp `n <= max_new - n_new - 1` makes overshooting the
+    budget impossible: output length is EXACTLY prompt + budget even when
+    every draft would be accepted."""
+    cfg = tiny_cfg()
+    model, variables = build(cfg)
+    eng = spec_engine(model, variables, spec_k=6)
+    for budget in (1, 2, 5):
+        (out,) = eng.run([[7] * 12], max_new_tokens=budget)
+        assert out == oracle(model, variables, [7] * 12, budget)
+        assert len(out) == 12 + budget
+
+
+def test_spec_max_len_boundary():
+    """Near the cache end speculation falls back to the plain step (the
+    rope-slice clamp hazard) and the engine still retires at exactly
+    max_len + 1 tokens, like the spec-off contract."""
+    cfg = tiny_cfg(block_size=16)
+    model, variables = build(cfg)
+    eng = spec_engine(model, variables, n_slots=1)
+    off = DecodeEngine(model, variables, n_slots=1, temperature=0.0,
+                       min_bucket=8, spec_decode=False)
+    (out,) = eng.run([[1, 2, 1, 2, 1]], max_new_tokens=1000)
+    assert len(out) == cfg.block_size + 1
+    assert eng.retire_counts["cache_full"] == 1
+    assert out == off.run([[1, 2, 1, 2, 1]], max_new_tokens=1000)[0]
+
+
+# ----------------------------------------------------------------------
+# acceptance-length edges (deterministic via a controlled drafter)
+# ----------------------------------------------------------------------
+
+def _run_with_drafter(model, variables, prompt, n, drafter, monkeypatch,
+                      **kw):
+    monkeypatch.setattr(decode_mod, "ngram_propose", drafter)
+    eng = spec_engine(model, variables, **kw)
+    (out,) = eng.run([prompt], max_new_tokens=n)
+    return eng, out
+
+
+def test_zero_accepted_still_exact(monkeypatch):
+    """A drafter that is ALWAYS wrong (proposes ref+1 at every position)
+    accepts nothing — every spec step emits exactly the plain step's one
+    correction token and the output stays bit-identical."""
+    cfg = tiny_cfg()
+    model, variables = build(cfg)
+    prompt, n = [1, 2, 3, 1, 2, 3], 8
+    ref = oracle(model, variables, prompt, n)
+
+    def wrong(tokens, k, **_kw):
+        i = len(tokens)
+        return [(ref[i + j] + 1) % cfg.vocab_size
+                for j in range(min(k, len(ref) - i))]
+
+    eng, out = _run_with_drafter(model, variables, prompt, n, wrong,
+                                 monkeypatch)
+    assert out == ref
+    assert eng.spec_drafted_tokens > 0
+    assert eng.spec_accepted_tokens == 0
+
+
+def test_all_k_accepted(monkeypatch):
+    """An oracle drafter (proposes the exact greedy continuation) gets
+    every valid draft token accepted: accepted == drafted, and each spec
+    step advances multiple tokens."""
+    cfg = tiny_cfg()
+    model, variables = build(cfg)
+    prompt, n = [1, 2, 3, 1, 2, 3], 9
+    ref = oracle(model, variables, prompt, n)
+
+    def perfect(tokens, k, **_kw):
+        i = len(tokens)
+        return ref[i:i + k]
+
+    eng, out = _run_with_drafter(model, variables, prompt, n, perfect,
+                                 monkeypatch, spec_k=3)
+    assert out == ref
+    assert eng.spec_drafted_tokens > 0
+    assert eng.spec_accepted_tokens == eng.spec_drafted_tokens
+    # 9 tokens in ceil(9/4)=3 spec steps (3 accepted + 1 correction each)
+    assert eng.tokens_per_step > 1.0
+
+
+def test_eos_inside_accepted_span(monkeypatch):
+    """EOS landing INSIDE an accepted draft prefix truncates the emission
+    at the EOS token: nothing past it is streamed, the slot retires with
+    reason 'eos', and tokens == the oracle cut at its EOS."""
+    cfg = tiny_cfg()
+    model, variables = build(cfg)
+    prompt = [1, 2, 3, 1, 2, 3]
+    ref = oracle(model, variables, prompt, 10)
+    eos = ref[len(prompt) + 2]          # third generated token
+
+    def perfect(tokens, k, **_kw):
+        i = len(tokens)
+        return ref[i:i + k]
+
+    monkeypatch.setattr(decode_mod, "ngram_propose", perfect)
+    eng = spec_engine(model, variables, eos_id=eos, spec_k=6)
+    (out,) = eng.run([prompt], max_new_tokens=10)
+    stop = ref.index(eos, len(prompt))
+    assert out == ref[:stop + 1]
+    assert out[-1] == eos
+
+
+# ----------------------------------------------------------------------
+# trace discipline
+# ----------------------------------------------------------------------
+
+def test_spec_one_trace_across_mixes():
+    """Every draft mix — hits, misses, ragged lengths, retiring slots —
+    shares ONE compiled spec_step program; the plain step and the admit
+    buckets keep their own budgets; nothing exceeds a TraceGuard."""
+    cfg = tiny_cfg()
+    model, variables = build(cfg)
+    eng = spec_engine(model, variables, n_slots=3)
+    eng.run(PROMPTS, max_new_tokens=7)
+    eng.run([[2, 4, 2, 4, 2], [8, 8, 8, 8, 8, 8, 8, 8, 8]],
+            max_new_tokens=5)
+    assert eng.spec_step_traces == 1
+    assert eng.step_traces <= 1
+    assert all(g.excess == 0 for g in eng.trace_guards.values())
+
+
+def test_enumerate_trace_signatures_spec_family():
+    sig = enumerate_trace_signatures(min_bucket=16, block_size=16,
+                                     max_len=64, prefill_chunk=0,
+                                     spec_k=4)
+    assert sig["spec_step"] == 1
+    off = enumerate_trace_signatures(min_bucket=16, block_size=16,
+                                     max_len=64, prefill_chunk=0)
+    assert off["spec_step"] == 0
+    chunked = enumerate_trace_signatures(min_bucket=16, block_size=16,
+                                         max_len=64, prefill_chunk=32,
+                                         spec_k=4)
+    assert chunked["spec_step"] == 1 and chunked["fused_step"] == 1
+
+
+def test_spec_knob_gating():
+    """SPEC_DECODE resolution: off at temperature > 0 (verify is greedy-
+    only), off at spec_k=0, and the explicit constructor arg wins."""
+    cfg = tiny_cfg()
+    model, variables = build(cfg)
+    hot = DecodeEngine(model, variables, n_slots=2, temperature=0.7,
+                       min_bucket=8, spec_decode=True, spec_k=4)
+    assert not hot.spec_decode
+    k0 = DecodeEngine(model, variables, n_slots=2, temperature=0.0,
+                      min_bucket=8, spec_decode=True, spec_k=0)
+    assert not k0.spec_decode
+    off = DecodeEngine(model, variables, n_slots=2, temperature=0.0,
+                       min_bucket=8, spec_decode=False)
+    assert not off.spec_decode
+    out_on = spec_engine(model, variables).run(PROMPTS[:2], 6)
+    out_off = off.run(PROMPTS[:2], 6)
+    assert out_on == out_off
+
+
+def test_chunked_prefill_with_spec():
+    """A chunked engine speculates on chunk-free steps only; parity and
+    both trace pins hold with the fused and spec programs coexisting."""
+    cfg = tiny_cfg()
+    model, variables = build(cfg)
+    eng = spec_engine(model, variables, prefill_chunk=16)
+    prompts = [[1, 2, 3, 1, 2, 3], list(range(1, 40)), [7] * 10]
+    outs = eng.run(prompts, max_new_tokens=8)
+    for p, o in zip(prompts, outs):
+        assert o == oracle(model, variables, p, 8)
+    assert eng.fused_step_traces == 1
+    assert eng.spec_step_traces == 1
+
+
+# ----------------------------------------------------------------------
+# scheduler stream ordering under multi-token emission
+# ----------------------------------------------------------------------
+
+def test_scheduler_streams_spec_tokens_in_order():
+    """Multi-token StepResult lists fan into the per-request streams in
+    generation order: each handle's token sequence equals the offline
+    oracle's continuation, TTFT fires once, and the spec counters land
+    on the scheduler's metrics registry."""
+    cfg = tiny_cfg()
+    model, variables = build(cfg)
+    prompts = [[1, 2, 3, 1, 2, 3, 1, 2], [4, 9, 4, 9, 4, 9], [20] * 10]
+    budget = 8
+
+    async def main():
+        eng = spec_engine(model, variables, n_slots=2)
+        sched = Scheduler(eng, max_queue=8)
+        await sched.start()
+        handles = [sched.submit(p, budget) for p in prompts]
+        await asyncio.gather(*(h.result() for h in handles))
+        await sched.stop()
+        return eng, sched, handles
+
+    eng, sched, handles = asyncio.run(asyncio.wait_for(main(), 300))
+    for p, h in zip(prompts, handles):
+        ref = oracle(model, variables, p, budget)
+        assert h.tokens == ref[len(p):], \
+            "streamed tokens out of order or diverged"
+        assert h.retired.reason == "budget"
+    m = sched.metrics.counters
+    assert m["spec_drafted_tokens"] == eng.spec_drafted_tokens > 0
+    assert m["spec_accepted_tokens"] == eng.spec_accepted_tokens
+    assert m["tokens_out"] == len(prompts) * budget
+    # gauges registered and live
+    snap = sched.metrics.snapshot()["gauges"]
+    assert snap["serve_spec_accepted_token_rate"] == pytest.approx(
+        eng.accepted_token_rate, abs=1e-6)
+    assert snap["serve_engine_tokens_per_step"] > 0

@@ -567,8 +567,10 @@ def test_decode_signatures_within_budget_both_modes():
     ws = wave.signatures["enumerated"]
     assert ws["fused_step"] == 0 and ws["admit"] == len(ws["buckets"])
     assert ws["buckets"] == sorted(set(ws["buckets"]))  # distinct, sorted
+    assert ws["spec_step"] == 1             # round-20 verify program
     cs = chunked.signatures["enumerated"]
-    assert cs == {"step": 1, "fused_step": 1, "admit": 0, "buckets": []}
+    assert cs == {"step": 1, "fused_step": 1, "admit": 0, "spec_step": 1,
+                  "buckets": []}
 
 
 def test_mutation_bucketing_bug_fails_signature_enumeration(monkeypatch):

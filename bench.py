@@ -474,6 +474,273 @@ def _serve_bench(platform: str) -> dict:
     return out
 
 
+def _serve_tier_bench(platform: str) -> dict:
+    """serve_load_tier leg (BENCH_SERVE=1 BENCH_SERVE_TIER=1): the
+    host-RAM KV tier A/B (ISSUE 17). Same seeded 80%-shared-prefix
+    Poisson traffic as serve_load_prefix, but the HBM block pool is
+    clamped to ~0.1x the traffic's no-reuse working set, so the LRU
+    genuinely evicts retired shared-prefix chains mid-drive. Tier OFF,
+    those evictions drop the KV and every re-arrival re-prefills the
+    system prompt; tier ON, the same evictions demote to host RAM and
+    the next radix hit promotes the chain back with one batched
+    device_put. The SAME arrival schedule runs both ways and the line
+    reports the tier's demote/promote/drop counters, host hit rate,
+    prefix hit rate both ways, and the accept booleans the ROADMAP
+    reads: zero blocks dropped at the host budget and zero requests
+    lost, hit rate recovered vs the tier-off collapse, and tier TTFT
+    p50 bounded by 1.5x tier-off (a promote must cost a host->HBM
+    copy, never a re-prefill)."""
+    import asyncio
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_pytorch_tpu.config import LLMConfig, flagship_gpt124m
+    from distributed_pytorch_tpu.engine import DecodeEngine
+    from distributed_pytorch_tpu.models.gpt import LLM
+    from distributed_pytorch_tpu.serve.scheduler import Scheduler, ShedError
+
+    n_dev = len(jax.devices())
+    if platform == "tpu":
+        cfg = flagship_gpt124m()
+        S = int(os.environ.get("BENCH_DECODE_LEN", "1024"))
+        slots = int(os.environ.get("BENCH_DECODE_SLOTS", "32"))
+        kv_block = int(os.environ.get("BENCH_KV_BLOCK", "128"))
+        dtype = jnp.bfloat16
+        n_req, p_lo, p_hi, b_lo, b_hi = 192, 64, 512, 16, 96
+        preset = "gpt2_124m"
+    else:  # CPU proxy mirrors _serve_bench's tiny model
+        cfg = LLMConfig(vocab_size=1024, block_size=128, n_embd=128,
+                        n_head=4, n_kv_heads=4, attn="mha", n_layer=2,
+                        up_dim=256, non_linearity="swiglu", pos_emb="rope")
+        S, slots, dtype = 128, 4, jnp.float32
+        kv_block = int(os.environ.get("BENCH_KV_BLOCK", "16"))
+        n_req, p_lo, p_hi, b_lo, b_hi = 32, 4, 48, 4, 12
+        preset = "cpu_tiny"
+    model = LLM(cfg, compute_dtype=dtype, attn_impl="auto")
+    rng = jax.random.PRNGKey(0)
+    dummy = jnp.zeros((1, cfg.block_size), jnp.int32)
+    variables = jax.jit(model.init)({"params": rng, "dropout": rng},
+                                    dummy, dummy)
+    cache_dtype = os.environ.get("BENCH_CACHE_DTYPE", "") or None
+
+    # serve_load_prefix's exact traffic shape and rng seed: 80% of the
+    # requests share a fixed 5-block system prompt + short tail
+    prefix_frac = 0.8
+    npr = np.random.default_rng(0)
+    sys_prompt = list(npr.integers(0, cfg.vocab_size, 5 * kv_block))
+    reqs = []
+    for _ in range(n_req):
+        if npr.random() < prefix_frac:
+            tail = list(npr.integers(
+                0, cfg.vocab_size, int(npr.integers(1, kv_block // 2 + 2))))
+            reqs.append((sys_prompt + tail, int(npr.integers(b_lo, b_hi))))
+        else:
+            reqs.append((list(npr.integers(0, cfg.vocab_size,
+                                           int(npr.integers(p_lo, p_hi)))),
+                         int(npr.integers(b_lo, b_hi))))
+
+    # the no-reuse working set (blocks to hold every request's full
+    # chain), then the clamp: the HBM pool gets ~0.1x of it — floored
+    # so one full-length sequence plus the shared prefix always fits,
+    # else a single request could deadlock the pool
+    ws_blocks = sum((len(p) + b) // kv_block + 1 for p, b in reqs)
+    n_blocks = max(int(0.1 * ws_blocks) + 1,
+                   S // kv_block + len(sys_prompt) // kv_block + 2)
+
+    def make_engine(tier: bool, pool: int = 0) -> "DecodeEngine":
+        return DecodeEngine(model, variables, n_slots=slots, max_len=S,
+                            temperature=1.0, top_k=50,
+                            cache_dtype=cache_dtype, block_size=kv_block,
+                            n_blocks=pool or n_blocks, prefix_cache=True,
+                            host_tier=tier,
+                            host_blocks=ws_blocks if tier else None)
+
+    def warm(e):
+        for bucket in sorted({e.prefill_bucket(len(p)) for p, _ in reqs}):
+            e.admit(list(npr.integers(0, cfg.vocab_size, bucket)), 1)
+        e.admit(reqs[0][0], 2)
+        e.step()
+
+    eng = make_engine(tier=True)
+    warm(eng)
+
+    # probe the steady step time -> offered arrival rate (~1.3x
+    # service); the clamped pool may not fit every slot's probe
+    # sequence — fill as many as it allows, the step time is what counts
+    from distributed_pytorch_tpu.ops.block_pool import NoFreeBlocks
+    while eng.free_slots:
+        try:
+            eng.admit(list(npr.integers(0, cfg.vocab_size,
+                                        min(p_hi, S // 2) - 1)), 10 ** 9)
+        except NoFreeBlocks:
+            break
+    eng.step()
+    t0 = time.perf_counter()
+    probe_steps = 8
+    for _ in range(probe_steps):
+        eng.step()
+    jax.device_get(eng.tok)
+    step_s = (time.perf_counter() - t0) / probe_steps
+    for sid in eng.live_seq_ids:
+        eng.set_budget(sid, 1)
+    while eng.n_live:
+        eng.step()
+
+    # compile the promote program OUTSIDE the timed window (the step
+    # family is warmed above; the batched host->HBM copy is its own
+    # program): retire a multi-block chain, churn the clamped pool so
+    # the LRU demotes it to the host tier, then re-admit the same
+    # prompt — the radix hit promotes the chain back and compiles
+    wp = list(npr.integers(0, cfg.vocab_size, 3 * kv_block))
+    eng.admit(wp, 1)
+    eng.step()
+    for _ in range(6):
+        try:
+            eng.admit(list(npr.integers(0, cfg.vocab_size, S - kv_block)),
+                      1)
+        except NoFreeBlocks:
+            break
+        eng.step()
+    eng.admit(wp, 1)
+    while eng.n_live:
+        eng.step()
+
+    # offered load sits BELOW saturation (0.6x, vs serve_load's 1.3x):
+    # the failure mode under test is IDLE-prefix eviction — a saturated
+    # drive keeps the shared prefix pinned by live refcounts, so the
+    # clamped pool would never evict it and both arms would look alike.
+    # Sub-saturation Poisson gaps let the prefix go refcount-0, the
+    # churn evicts it, and the two arms genuinely diverge.
+    mean_budget = (b_lo + b_hi) / 2
+    load_factor = float(os.environ.get("BENCH_SERVE_LOAD", "0.6"))
+    req_rate = slots / (mean_budget * step_s) * load_factor
+    arrivals = np.cumsum(npr.exponential(1.0 / req_rate, size=n_req))
+
+    def drive(e):
+        async def _run():
+            sched = Scheduler(e, max_queue=4 * slots)
+            await sched.start()
+            consumers, shed = [], 0
+            start = time.perf_counter()
+            for (prompt, budget), at in zip(reqs, arrivals):
+                delay = start + at - time.perf_counter()
+                if delay > 0:
+                    await asyncio.sleep(delay)
+                try:
+                    h = sched.submit(prompt, budget)
+                except ShedError:
+                    shed += 1
+                    continue
+                consumers.append(asyncio.ensure_future(h.result()))
+            await asyncio.gather(*consumers, return_exceptions=True)
+            dt = time.perf_counter() - start
+            await sched.stop()
+            return sched, shed, dt
+
+        return asyncio.run(_run())
+
+    def run_arm(e):
+        pre = (e.prompt_tokens, e.prefix_hit_tokens, e.prefilled_tokens)
+        sched, shed, dt = drive(e)
+        admitted = max(sched.metrics.counters["admitted"]
+                       - sched.metrics.counters["requeued"], 1)
+        s = sched.metrics.summary()
+        return {"hit_rate": ((e.prefix_hit_tokens - pre[1])
+                             / max(e.prompt_tokens - pre[0], 1)),
+                "prefilled_per_request": (e.prefilled_tokens - pre[2])
+                / admitted,
+                "ttft_p50_ms": s["ttft"].get("p50_ms"),
+                "ttft_p99_ms": s["ttft"].get("p99_ms"),
+                "itl_p50_ms": s["itl"].get("p50_ms"),
+                "itl_p99_ms": s["itl"].get("p99_ms"),
+                "shed_rate": round(shed / n_req, 3),
+                "lost": n_req - shed - sched.metrics.counters["completed"],
+                "tok_s_chip": round(sched.metrics.counters["tokens_out"]
+                                    / dt / n_dev, 1)}
+
+    # arm 1 — tier ON, clamped pool (warm/probe snapshotted out)
+    tpre = dict(eng.host_tier.counters())
+    on = run_arm(eng)
+    tier_c = {k: v - tpre.get(k, 0)
+              for k, v in eng.host_tier.counters().items()
+              if k in ("demoted", "promoted", "dropped")}
+
+    # arm 2 — tier OFF, SAME clamped pool, SAME arrivals: evictions
+    # drop KV outright, so the shared prefix keeps re-prefilling
+    base_eng = make_engine(tier=False)
+    warm(base_eng)
+    off = run_arm(base_eng)
+
+    # arm 3 — the warm-HBM reference: tier off, pool sized past the
+    # whole working set so NOTHING ever evicts. This is the
+    # serve_load_prefix-equivalent ceiling the ISSUE's "within 10%"
+    # hit-rate bound and "1.5x warm-HBM" TTFT bound compare against.
+    warm_eng = make_engine(
+        tier=False, pool=ws_blocks + slots * (S // kv_block) + 1)
+    warm(warm_eng)
+    ref = run_arm(warm_eng)
+
+    return {"metric": ("serve_tokens_per_sec_per_chip" if platform == "tpu"
+                       else "cpu_proxy_serve_tokens_per_sec_per_chip"),
+            "value": on["tok_s_chip"], "unit": "tok/s/chip",
+            "vs_baseline": 0,
+            "ttft_p50_ms": on["ttft_p50_ms"],
+            "ttft_p99_ms": on["ttft_p99_ms"],
+            "itl_p50_ms": on["itl_p50_ms"], "itl_p99_ms": on["itl_p99_ms"],
+            "shed_rate": on["shed_rate"],
+            "prefix_frac": prefix_frac,
+            "n_kv_blocks": n_blocks, "working_set_blocks": ws_blocks,
+            "pool_clamp_x": round(n_blocks / ws_blocks, 3),
+            "host_tier_blocks": ws_blocks,
+            "tier_demoted_blocks": tier_c.get("demoted", 0),
+            "tier_promoted_blocks": tier_c.get("promoted", 0),
+            "tier_dropped_blocks": tier_c.get("dropped", 0),
+            "host_tier_hit_rate": round(eng.host_tier_hit_rate, 4),
+            "host_tier_occupancy": round(eng.host_tier_occupancy, 4),
+            "prefix_hit_rate": round(on["hit_rate"], 4),
+            "prefix_hit_rate_tier_off": round(off["hit_rate"], 4),
+            "prefix_hit_rate_warm_hbm": round(ref["hit_rate"], 4),
+            "prefilled_per_request": round(on["prefilled_per_request"], 1),
+            "prefilled_per_request_tier_off": round(
+                off["prefilled_per_request"], 1),
+            "prefilled_per_request_warm_hbm": round(
+                ref["prefilled_per_request"], 1),
+            "tier_off_ttft_p50_ms": off["ttft_p50_ms"],
+            "tier_off_shed_rate": off["shed_rate"],
+            "tier_off_tokens_per_sec_per_chip": off["tok_s_chip"],
+            "warm_hbm_ttft_p50_ms": ref["ttft_p50_ms"],
+            "warm_hbm_tokens_per_sec_per_chip": ref["tok_s_chip"],
+            "lost_to_preemption": on["lost"],
+            "tier_off_lost_to_preemption": off["lost"],
+            # the accept booleans (ISSUE 17): nothing dropped at the
+            # host budget and no request lost; the tier holds the
+            # warm-HBM hit rate within 10% despite the 0.1x pool; a
+            # tier hit costs a host->HBM copy, never a re-prefill
+            # (TTFT p50 within 1.5x of warm HBM); and the tier-off arm
+            # demonstrably re-prefills more than the tier does
+            "accept_zero_lost_to_eviction": bool(
+                tier_c.get("dropped", 0) == 0 and on["lost"] == 0),
+            "accept_hit_rate_held": bool(
+                on["hit_rate"] >= 0.9 * ref["hit_rate"]),
+            "accept_tier_ttft_bounded": bool(
+                on["ttft_p50_ms"] is not None
+                and ref["ttft_p50_ms"] is not None
+                and on["ttft_p50_ms"] <= 1.5 * ref["ttft_p50_ms"]),
+            "accept_tier_off_collapses": bool(
+                off["prefilled_per_request"]
+                > on["prefilled_per_request"]),
+            "probe_step_ms": round(step_s * 1e3, 2),
+            "offered_rps": round(req_rate, 2), "load_factor": load_factor,
+            "n_requests": n_req, "n_slots": slots, "cache_len": S,
+            "kv_block": kv_block,
+            "cache_dtype": jnp.dtype(eng.cache_dtype).name,
+            "n_chips": n_dev, "device": jax.devices()[0].device_kind,
+            "preset": preset}
+
+
 def _serve_chunked_bench(platform: str) -> dict:
     """serve_load_chunked leg (BENCH_SERVE=1 BENCH_PREFILL_CHUNK=
     128,256,512): the chunked-prefill A/B the round-12 latency model
@@ -1010,6 +1277,8 @@ def run_bench(platform: str, only_recipe: str | None = None) -> dict:
             return _serve_chunked_bench(platform)
         if os.environ.get("BENCH_SERVE_SPEC"):
             return _serve_spec_bench(platform)
+        if os.environ.get("BENCH_SERVE_TIER"):
+            return _serve_tier_bench(platform)
         return _serve_bench(platform)
 
     if os.environ.get("BENCH_DECODE"):
@@ -1324,6 +1593,14 @@ def main() -> None:
                     ("serve_load_spec",
                      {"BENCH_SERVE": "1", "BENCH_SERVE_SPEC": "1",
                       "FLASH_DECODE": "on", "BENCH_SPEC_K": "2,4"}),
+                    # ISSUE 17: host-RAM KV tier — shared-prefix traffic
+                    # with the HBM pool clamped to ~0.1x working set,
+                    # tier on vs off under identical seeded arrivals
+                    # (zero-dropped / hit-rate-recovered / TTFT-bounded
+                    # accept booleans)
+                    ("serve_load_tier",
+                     {"BENCH_SERVE": "1", "BENCH_SERVE_TIER": "1",
+                      "FLASH_DECODE": "on"}),
                     # PR 8: replicated serving behind the fault-tolerant
                     # router — 3 replica processes, one SIGKILLed
                     # mid-Poisson-drive and replaced; zero-failed /

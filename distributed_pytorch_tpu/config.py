@@ -139,6 +139,18 @@ register_knob("SPEC_DECODE", "auto",
 register_knob("SPEC_K", "4", lambda s: int(s) if s.strip() else 4,
               "speculative draft length: tokens the n-gram drafter "
               "proposes per step (verify runs K+1 positions)")
+register_knob("KV_HOST_TIER", "auto",
+              lambda s: _onoff(s) if s.strip() else "auto",
+              "host-RAM KV second-tier gate (ops/kv_tier.py): evicted "
+              "prefix blocks demote to host RAM and promote back on a "
+              "radix hit; auto = on iff KV_HOST_BLOCKS > 0")
+register_knob("KV_HOST_BLOCKS", "0", lambda s: int(s) if s.strip() else 0,
+              "host-tier budget in KV blocks (0 with KV_HOST_TIER=on "
+              "defaults to the HBM pool size; serve CLI --kv-host-gb "
+              "prices GB into blocks via train/memplan.py)")
+register_knob("KV_TIER_DIGEST_K", "8", lambda s: int(s) if s.strip() else 8,
+              "radix-prefix digest width: top-k chain digests by cached "
+              "depth a replica advertises for cache-aware routing")
 
 # --- observability / fault injection ---
 register_knob("TRACE", "on",

@@ -81,6 +81,22 @@ thousands of requests share a system prompt:
   the plain step (acceptance compares argmax, which would change the
   sampling distribution).
 
+* **Host-RAM KV tier** (`KV_HOST_TIER=auto|on|off`, `KV_HOST_BLOCKS`;
+  this round — the ZeRO-Offload thesis applied to serving): the prefix
+  cache was capped at HBM size — an evicted refcount-0 registered block
+  was simply gone. With the tier on, the pool's eviction hook DEMOTES
+  the block's rows (every cache leaf, int8 scale sidecars included) to a
+  host-side pool (ops/kv_tier.py) with its own block budget and LRU,
+  still keyed by the radix chain key; `_match_prefix` becomes tier-aware
+  (HBM hit > host hit > miss) and PROMOTES a host-hit chain back into
+  freshly allocated HBM blocks via one batched device_put plus a single
+  fixed-shape jitted copy program — before the slot's first step, so
+  the step/admit families never trace anything new and the promote cost
+  lands in queue-wait, not ITL. One PCIe copy buys back a prefill; the
+  host/HBM ratio multiplies the effective prefix cache. The engine also
+  exports a compact radix-prefix digest (`kv_digest`) that
+  serve/router.py uses for cache-aware sticky dispatch across replicas.
+
 Host/device split as before: sampling, cache writes, and positions are
 device-side; the allocator, radix index, and retirement logic are plain
 Python on the host thread that owns the engine.
@@ -88,6 +104,7 @@ Python on the host thread that owns the engine.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import dataclasses
 import time
@@ -101,8 +118,9 @@ from distributed_pytorch_tpu.models.generate import sample_token
 from distributed_pytorch_tpu.models.gpt import init_paged_cache
 from distributed_pytorch_tpu.obs.flight import FlightRecorder
 from distributed_pytorch_tpu.obs.retrace import TraceGuard
+from distributed_pytorch_tpu.ops import kv_tier
 from distributed_pytorch_tpu.ops.block_pool import (BlockPool, NoFreeBlocks,
-                                                    chain_keys)
+                                                    _child_digest, chain_keys)
 from distributed_pytorch_tpu.parallel import context
 
 
@@ -333,15 +351,19 @@ def enumerate_trace_signatures(*, min_bucket: int, block_size: int,
     pow2 bucket. Speculative decoding (spec_k > 0) adds exactly ONE
     spec_step program: the draft buffer is a fixed (n_slots, K) shape
     and validity lengths are traced, so every draft mix — including the
-    all-miss mix — shares it. parallel/commscheck.py asserts these
-    counts against the engine's TraceGuard budgets at lint time."""
+    all-miss mix — shares it. The host KV tier adds exactly ONE promote
+    program regardless of chain length (the copy's shape is one block's
+    rows; the block id is traced), counted here as the static max — a
+    tier-off engine budgets it to 0 and never builds it.
+    parallel/commscheck.py asserts these counts against the engine's
+    TraceGuard budgets at lint time."""
     buckets = enumerate_prefill_buckets(min_bucket, block_size, max_len)
     spec = 1 if spec_k else 0
     if prefill_chunk:
         return {"step": 1, "fused_step": 1, "admit": 0,
-                "spec_step": spec, "buckets": []}
+                "spec_step": spec, "promote": 1, "buckets": []}
     return {"step": 1, "fused_step": 0, "admit": len(buckets),
-            "spec_step": spec, "buckets": buckets}
+            "spec_step": spec, "promote": 1, "buckets": buckets}
 
 
 @dataclasses.dataclass
@@ -465,6 +487,8 @@ class DecodeEngine:
                  prefill_chunk: int = 0,
                  spec_decode: Optional[bool] = None,
                  spec_k: Optional[int] = None,
+                 host_tier: Optional[bool] = None,
+                 host_blocks: Optional[int] = None,
                  flight_capacity: int = 4096):
         cfg = model.config
         self.model = model
@@ -526,6 +550,32 @@ class DecodeEngine:
         self.n_blocks = n_blocks
         self.block_pool = BlockPool(n_blocks, bs)
         self.prefix_cache = prefix_cache
+
+        # host-RAM second tier (ops/kv_tier.py): KV_HOST_TIER=auto defers
+        # to the constructor request / a nonzero KV_HOST_BLOCKS budget,
+        # on/off overrides — the resolve shape the quant knobs use.
+        # Meaningless without the radix index (no chain keys to demote
+        # under), so prefix_cache=False forces it off.
+        tier_mode = knob("KV_HOST_TIER")
+        if host_tier is not None:
+            tier_mode = "on" if host_tier else "off"
+        hb = host_blocks if host_blocks is not None \
+            else int(knob("KV_HOST_BLOCKS"))
+        tier_on = prefix_cache and (
+            tier_mode == "on" or (tier_mode == "auto" and hb > 0))
+        if tier_on and hb <= 0:
+            hb = self.n_blocks       # default budget: mirror the HBM pool
+        self.host_tier = kv_tier.HostTier(hb) if tier_on else None
+        if self.host_tier is not None:
+            self.block_pool.on_evict = self._demote_block
+        # cumulative ancestry digest -> cached depth (blocks), LRU-capped:
+        # the router-facing radix-prefix digest (`kv_digest`). Maintained
+        # even with the tier off — stickiness pays for plain HBM prefix
+        # reuse too.
+        self._digest_k = max(int(knob("KV_TIER_DIGEST_K")), 1)
+        self._digest_index: collections.OrderedDict[str, int] = \
+            collections.OrderedDict()
+        self._digest_cap = max(64, 8 * self._digest_k)
 
         # chunked prefill (module docstring): the per-step prefill token
         # budget. Chunks must be whole blocks so every chunk's write
@@ -601,6 +651,7 @@ class DecodeEngine:
         self._step_fn = None
         self._fused_step_fn = None
         self._spec_step_fn = None
+        self._promote_fn = None
         self._admit_fns: dict[int, Any] = {}
         # retrace guards (obs/retrace.py): each compiled family budgets
         # its legitimate trace count — step/fused_step trace ONCE for any
@@ -614,6 +665,9 @@ class DecodeEngine:
             "spec_step": TraceGuard(
                 "engine.spec_step",
                 budget=1 if self.spec_decode else 0),
+            "promote": TraceGuard(
+                "engine.promote",
+                budget=1 if self.host_tier is not None else 0),
         }
         self.admit_traces: dict[int, int] = {}  # bucket -> trace count
         # lifetime counters — the stable occupancy/accounting surface a
@@ -685,6 +739,17 @@ class DecodeEngine:
             on_trace=self.trace_guards["spec_step"].mark)
         self._spec_step_fn = jax.jit(spec, donate_argnums=self._donate)
         return self._spec_step_fn
+
+    def _get_promote_fn(self):
+        if self._promote_fn is not None:
+            return self._promote_fn
+        fn = kv_tier.make_promote_block_fn(
+            on_trace=self.trace_guards["promote"].mark)
+        # promote donates the CACHES (arg 0, vs arg 1 in the step
+        # families) so the pool recycles in place on TPU
+        donate = (0,) if jax.default_backend() == "tpu" else ()
+        self._promote_fn = jax.jit(fn, donate_argnums=donate)
+        return self._promote_fn
 
     def _get_admit_fn(self, bucket: int):
         fn = self._admit_fns.get(bucket)
@@ -814,6 +879,62 @@ class DecodeEngine:
         return prefill_bucket_for(prompt_len, self.min_bucket,
                                   self.block_size, self.max_len)
 
+    def _note_digest(self, digest: bytes, depth: int) -> None:
+        """Fold one cumulative ancestry digest into the router-facing
+        index, keeping the deepest cached depth seen for it and aging
+        cold chains out LRU-first."""
+        idx = self._digest_index
+        hexd = digest.hex()
+        idx[hexd] = max(idx.get(hexd, 0), depth)
+        idx.move_to_end(hexd)
+        while len(idx) > self._digest_cap:
+            idx.popitem(last=False)
+
+    def _register_blocks(self, tokens: list, n_full: int,
+                         blocks: list) -> None:
+        """Publish the first `n_full` full blocks of `tokens` under their
+        chain keys (first-writer-wins, so re-publishing a chunked prompt's
+        earlier blocks is a no-op) and record the chain's cumulative
+        digests for `kv_digest`. The single register path — admission,
+        retirement, and per-chunk publication all land here."""
+        if not self.prefix_cache or n_full <= 0:
+            return
+        keys = chain_keys(tokens, self.block_size, n_full)
+        for key, blk in zip(keys, blocks):
+            self.block_pool.register(blk, key)
+        # the digest of the first d blocks is key d's parent; the full
+        # chain needs one extra fold past the last key
+        for depth in range(1, n_full):
+            self._note_digest(keys[depth][0], depth)
+        self._note_digest(_child_digest(*keys[-1]), n_full)
+
+    def kv_digest(self, k: Optional[int] = None) -> dict:
+        """Compact radix-prefix digest for the router's health probe: the
+        top-k cumulative chain digests by cached depth (in blocks),
+        deepest first. A replica that recently served a prefix advertises
+        it here whether the blocks sit in HBM or the host tier — both
+        re-admit as hits — and the router steers same-prefix requests
+        back (serve/router.py sticky dispatch)."""
+        if k is None:
+            k = self._digest_k
+        entries = sorted(self._digest_index.items(),
+                         key=lambda kv: -kv[1])[:k]
+        return {"block_size": self.block_size,
+                "entries": [[depth, hexd] for hexd, depth in entries]}
+
+    # -- host-tier accounting (scheduler gauges read these) -------------
+    @property
+    def host_tier_occupancy(self) -> float:
+        return self.host_tier.occupancy if self.host_tier else 0.0
+
+    @property
+    def host_tier_hit_rate(self) -> float:
+        return self.host_tier.hit_rate if self.host_tier else 0.0
+
+    @property
+    def promote_traces(self) -> int:
+        return self.trace_guards["promote"].count
+
     def _retire_reason(self, slot: int, last_tok: int) -> Optional[str]:
         seq = self._slots[slot]
         if self.eos_id is not None and last_tok == self.eos_id:
@@ -830,12 +951,12 @@ class DecodeEngine:
         # publish the sequence's full blocks into the prefix cache before
         # releasing: refcount-0 registered blocks land on the LRU, so a
         # follow-up (or a preemption resume) re-admits with a prefix hit
-        if self.prefix_cache:
-            full = min(seq.pos, len(seq.blocks) * self.block_size) \
-                // self.block_size
-            for key, blk in zip(chain_keys(seq.tokens, self.block_size,
-                                           full), seq.blocks):
-                self.block_pool.register(blk, key)
+        # — and with the host tier on, a later eviction demotes instead
+        # of dropping, so even a preempted-under-pressure prefix resumes
+        # from cache
+        full = min(seq.pos, len(seq.blocks) * self.block_size) \
+            // self.block_size
+        self._register_blocks(seq.tokens, full, seq.blocks)
         self.block_pool.release_all(seq.blocks)
         self._tables_h[slot, :] = 0
         self._tables_dirty = True
@@ -854,20 +975,67 @@ class DecodeEngine:
                 return ret
         return None
 
+    def _demote_block(self, blk: int, key: tuple) -> None:
+        """Block-pool eviction hook: instead of losing the evicted
+        block's KV, snapshot its rows to the host tier under the same
+        chain key. Fires inside `alloc()` wherever the engine allocates
+        (admission, `_ensure_blocks` growth after a preemption, chunk
+        growth, spec-draft growth) — the block is refcount-0 and its
+        device contents still intact when this runs."""
+        self.host_tier.demote(key, kv_tier.snapshot_block(self.caches, blk))
+
+    def _promote_blocks(self, staged: list) -> None:
+        """Flush staged promotions: ONE batched host->device transfer
+        for every staged block's rows (a list of block pytrees is itself
+        a pytree, so this is a single `device_put`), then the one
+        fixed-shape jitted copy program per block. Runs at admission
+        time, before the slot's first prefill/step — the promote cost
+        lands in queue-wait, and the step families never trace anything
+        new for it."""
+        rows_dev = jax.device_put([rows for _, rows in staged])
+        fn = self._get_promote_fn()
+        with self._ctx():
+            for (blk, _), rows in zip(staged, rows_dev):
+                self.caches = fn(self.caches, rows, jnp.int32(blk))
+
     def _match_prefix(self, toks: list) -> tuple[int, list]:
         """Longest cached block-chain prefix of `toks`, capped so at least
         one suffix token remains to prefill (the prefill must produce the
-        logits the first sampled token comes from). Returns
-        (prefix_len, matched block ids) WITHOUT taking refs."""
+        logits the first sampled token comes from). Tier-aware: an HBM
+        hit shares the resident block; a host-tier hit allocates a fresh
+        HBM block, re-registers the chain key, and stages the host rows
+        for promotion; the first full miss ends the walk. Returns
+        (prefix_len, matched block ids) WITH one reference taken per
+        matched block — refs must be taken inside the walk, because a
+        host-hit `alloc()` can evict from the LRU and a matched block
+        must never be the one evicted. Callers own the refs
+        (`release_all(matched)` on admission rollback)."""
         if not self.prefix_cache:
             return 0, []
         matched: list[int] = []
+        staged: list[tuple[int, Any]] = []
         limit = (len(toks) - 1) // self.block_size
         for key in chain_keys(toks, self.block_size, limit):
             blk = self.block_pool.lookup(key)
-            if blk is None:
+            if blk is not None:
+                self.block_pool.ref(blk)
+                matched.append(blk)
+                continue
+            if self.host_tier is None or not self.host_tier.contains(key):
                 break
+            blk = self.block_pool.alloc()    # ref=1; eviction demotes
+            if blk is None:
+                break      # pool saturated: stop promoting, prefill rest
+            staged.append((blk, self.host_tier.pop(key)))
+            # re-register under the same key: the chain stays addressable
+            # and deeper same-prefix admissions hit it in HBM again.
+            # Registration precedes the flush, but nothing can read or
+            # evict the block before `_promote_blocks` below — it is
+            # referenced and no device program runs during the walk.
+            self.block_pool.register(blk, key)
             matched.append(blk)
+        if staged:
+            self._promote_blocks(staged)
         return len(matched) * self.block_size, matched
 
     def admit(self, prompt, max_new_tokens: int,
@@ -902,10 +1070,9 @@ class DecodeEngine:
         suffix = toks[prefix_len:]
         bucket = min(self.prefill_bucket(len(suffix)),
                      self.max_len - prefix_len)
-        # take prefix refs BEFORE allocating: alloc may evict from the
-        # LRU, and a matched block must not be the one evicted
-        for blk in matched:
-            self.block_pool.ref(blk)
+        # matched blocks arrive referenced from the tier-aware walk
+        # (alloc below may evict from the LRU, and a matched block must
+        # not be the one evicted — or demoted)
         new_ids = self.block_pool.alloc_many(bucket // bs)
         if new_ids is None:
             self.block_pool.release_all(matched)
@@ -946,9 +1113,7 @@ class DecodeEngine:
         self.prefilled_tokens += len(suffix)
         # publish the prompt's full blocks now — immutable as of this
         # prefill — so concurrent same-prefix requests hit immediately
-        if self.prefix_cache:
-            for key, blk in zip(chain_keys(toks, bs, L // bs), blocks):
-                self.block_pool.register(blk, key)
+        self._register_blocks(toks, L // bs, blocks)
         # a 1-token request (or instant EOS) finishes at admission
         retired = None
         reason = self._retire_reason(slot, first_tok)
@@ -973,9 +1138,7 @@ class DecodeEngine:
         suffix = toks[prefix_len:]
         first_rows = prefix_len + min(self.prefill_chunk, len(suffix))
         need = -(-first_rows // bs) - len(matched)
-        # take prefix refs BEFORE allocating (alloc may evict the LRU)
-        for blk in matched:
-            self.block_pool.ref(blk)
+        # matched blocks arrive referenced from the tier-aware walk
         new_ids = self.block_pool.alloc_many(max(need, 0))
         if new_ids is None:
             self.block_pool.release_all(matched)
@@ -1206,13 +1369,9 @@ class DecodeEngine:
             seq_c.suffix_done += take
             seq_c.pos = seq_c.prefix_len + seq_c.suffix_done
             self.prefilled_tokens += take
-            if self.prefix_cache:
-                full = min(seq_c.pos, len(seq_c.blocks) * self.block_size) \
-                    // self.block_size
-                for key, blk in zip(chain_keys(seq_c.tokens,
-                                               self.block_size, full),
-                                    seq_c.blocks):
-                    self.block_pool.register(blk, key)
+            full = min(seq_c.pos, len(seq_c.blocks) * self.block_size) \
+                // self.block_size
+            self._register_blocks(seq_c.tokens, full, seq_c.blocks)
             if chunk_done:
                 first_tok = int(sampled[slot_c])
                 seq_c.tokens.append(first_tok)

@@ -189,6 +189,11 @@ class BlockPool:
         self._index: dict[tuple, int] = {}       # chain key -> block
         self._lru: collections.OrderedDict[int, None] = \
             collections.OrderedDict()            # cached blocks, oldest first
+        # eviction hook: called as on_evict(blk, key) the moment a cached
+        # block is about to be recycled, BEFORE its contents are
+        # overwritten — ops/kv_tier.py demotes the block to host RAM
+        # here. None (default) keeps plain drop-at-eviction semantics.
+        self.on_evict = None
         # lifetime counters (engine metrics read these)
         self.n_evicted = 0
         self.n_allocs = 0
@@ -226,8 +231,14 @@ class BlockPool:
             blk = self._free.popleft()
         elif self._lru:
             blk, _ = self._lru.popitem(last=False)   # oldest cached
-            self._index.pop(self._key_of.pop(blk), None)
+            key = self._key_of.pop(blk)
+            self._index.pop(key, None)
             self.n_evicted += 1
+            if self.on_evict is not None:
+                # second-tier demotion: the block is refcount-0 and its
+                # contents still intact — the hook copies them out before
+                # this alloc's owner overwrites the rows
+                self.on_evict(blk, key)
         else:
             return None
         self._ref[blk] = 1

@@ -1,0 +1,183 @@
+"""Host-RAM second tier for the paged KV cache (the ZeRO-Offload thesis
+applied to serving, PAPERS.md).
+
+The block pool's prefix cache is capped at HBM size: a refcount-0
+registered block that falls off the HBM LRU is simply gone, and the next
+request for that prefix pays a full prefill. This module turns that
+eviction into a DEMOTION — the block's KV contents (every cache leaf,
+int8 scale sidecars included) move to a host-side pool with its own
+block budget and LRU, keyed by the SAME chain key the radix index uses —
+and turns a later radix hit on a demoted chain into a PROMOTION: a
+single batched `jax.device_put` of the chain's host blocks plus one
+fixed-shape jitted copy program per block into freshly allocated HBM
+blocks. One PCIe copy buys back a prefill; the host/HBM size ratio
+multiplies the effective prefix cache.
+
+Transport unit: a block chain at a block-aligned offset — exactly the
+interface the ROADMAP's disaggregated-prefill item will later point
+across hosts, which is why this lives as its own module instead of
+inline pool code.
+
+Placement contract:
+
+* **Demote** (`snapshot_block` + `HostTier.demote`): one `device_get` of
+  the evicted block's rows across every cache leaf. Runs on the host
+  thread that owns the engine, at pool-eviction time — the block is
+  refcount-0 and immutable (only FULL registered blocks are ever
+  evicted), so the copy races nothing.
+* **Promote** (`make_promote_block_fn`): the copy program has a FIXED
+  shape — one (block_size, ...) row-set per cache leaf plus a scalar
+  block id — so promoting a chain of any length reuses one compiled
+  program (budgeted in the engine's trace guards and audited by
+  parallel/commscheck.py; the fused step itself never traces anything
+  new). The HBM pool buffers are donated, so promotion recycles the
+  cache allocation in place exactly like the step families do on TPU.
+
+Host storage is plain numpy (there is no pinned-memory API to ask for
+portably through JAX; on TPU hosts `device_put` from numpy stages
+through pinned buffers anyway, and on CPU the "transfer" is a copy),
+sized in BLOCKS so the budget composes with `train/memplan.py`'s
+bytes-per-block pricing.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def tree_block_bytes(host_block) -> int:
+    """Total bytes of one demoted block across every cache leaf."""
+    return sum(int(leaf.nbytes) for leaf in
+               jax.tree_util.tree_leaves(host_block))
+
+
+def snapshot_block(caches, blk: int):
+    """Pull one block's rows out of the device cache pytree: the demote
+    transport. One transfer for all layers/leaves (k, v, scale sidecars,
+    MLA latents — whatever the cache holds) — THE deliberate
+    device->host sync of the demote path."""
+    rows = jax.tree_util.tree_map(lambda pool: pool[blk], caches)
+    return jax.device_get(rows)  # lint: allow(host-sync)
+
+
+def make_promote_block_fn(*, on_trace=None):
+    """The single promote copy program: write one staged block's rows
+    into HBM block `blk` of every cache leaf. Fixed shapes — (bs, ...)
+    rows + scalar block id — so every promotion of every chain shares
+    ONE compiled program; the engine jits it with the cache buffers
+    donated (TPU), recycling the pool allocation in place."""
+
+    def promote_block(caches, rows, blk):
+        if on_trace is not None:
+            on_trace()  # trace-time side effect
+        return jax.tree_util.tree_map(
+            lambda pool, r: pool.at[blk].set(r.astype(pool.dtype)),
+            caches, rows)
+
+    return promote_block
+
+
+class HostTier:
+    """Host-RAM block store with its own budget and LRU: the second tier
+    behind the HBM pool's refcount-0 prefix cache.
+
+    Entries are keyed by the radix CHAIN key — (parent_digest,
+    block_tokens), see ops/block_pool.py — so a host hit carries the
+    same proof an HBM hit does: the whole prefix up to and including
+    this block matches. Overflow drops the LRU entry (counted — the
+    only way tier-managed KV is ever lost), promotion CONSUMES the
+    entry (exactly one copy of a block's KV exists across the two
+    tiers; a later eviction simply demotes it again).
+
+    >>> tier = HostTier(capacity_blocks=256)
+    >>> tier.demote(key, snapshot_block(caches, blk))
+    >>> if tier.contains(key): rows = tier.pop(key)
+    """
+
+    def __init__(self, capacity_blocks: int):
+        assert capacity_blocks >= 1, "host tier needs a positive budget"
+        self.capacity = capacity_blocks
+        self._store: collections.OrderedDict[tuple, Any] = \
+            collections.OrderedDict()          # chain key -> host rows
+        # lifetime counters (engine properties / serve metrics read these)
+        self.n_demoted = 0        # blocks demoted into the tier
+        self.n_promoted = 0       # blocks promoted back to HBM
+        self.n_dropped = 0        # blocks lost to the host LRU cap
+        self.n_hits = 0           # probe hits (contains -> True)
+        self.n_misses = 0         # probe misses
+        self.demoted_bytes = 0
+        self.promoted_bytes = 0
+        # per-promotion byte sizes since the last drain — the scheduler
+        # feeds these to the promote-bytes histogram
+        self._promote_events: list[int] = []
+
+    # -- capacity accounting -------------------------------------------
+    @property
+    def n_blocks(self) -> int:
+        return len(self._store)
+
+    @property
+    def occupancy(self) -> float:
+        return len(self._store) / self.capacity if self.capacity else 0.0
+
+    @property
+    def hit_rate(self) -> float:
+        """Lifetime fraction of tier probes that hit (probes happen only
+        after an HBM radix miss, so this is the second-tier save rate)."""
+        probes = self.n_hits + self.n_misses
+        return self.n_hits / probes if probes else 0.0
+
+    # -- tier state machine --------------------------------------------
+    def contains(self, key: tuple) -> bool:
+        """Probe for a chain key (counted: the tier hit-rate gauge)."""
+        hit = key in self._store
+        if hit:
+            self.n_hits += 1
+        else:
+            self.n_misses += 1
+        return hit
+
+    def demote(self, key: tuple, host_rows) -> None:
+        """Store one evicted block's rows under its chain key, dropping
+        the LRU entry when the budget is exceeded. Re-demoting a key the
+        tier already holds just refreshes its LRU position (the content
+        is identical — chain keys are content addresses)."""
+        if key in self._store:
+            self._store.move_to_end(key)
+            return
+        self._store[key] = host_rows
+        self.n_demoted += 1
+        self.demoted_bytes += tree_block_bytes(host_rows)
+        while len(self._store) > self.capacity:
+            self._store.popitem(last=False)    # oldest demoted chain tail
+            self.n_dropped += 1
+
+    def pop(self, key: tuple):
+        """Consume a demoted block for promotion: returns its host rows
+        and removes the entry (the HBM copy becomes the only one)."""
+        host_rows = self._store.pop(key)
+        nbytes = tree_block_bytes(host_rows)
+        self.n_promoted += 1
+        self.promoted_bytes += nbytes
+        self._promote_events.append(nbytes)
+        return host_rows
+
+    def drain_promote_events(self) -> list:
+        """Per-promotion byte sizes since the last drain (and reset) —
+        the serve metrics' promote-bytes histogram samples."""
+        out, self._promote_events = self._promote_events, []
+        return out
+
+    def counters(self) -> dict:
+        """Stable counter snapshot (bench JSON / metrics sync read this
+        instead of poking attributes one by one)."""
+        return {"demoted": self.n_demoted, "promoted": self.n_promoted,
+                "dropped": self.n_dropped, "hits": self.n_hits,
+                "misses": self.n_misses,
+                "demoted_bytes": self.demoted_bytes,
+                "promoted_bytes": self.promoted_bytes,
+                "resident_blocks": len(self._store)}

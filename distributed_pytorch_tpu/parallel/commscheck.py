@@ -556,7 +556,7 @@ def audit_decode_cell(preset: str, cfg: LLMConfig, recipe: str,
     brute = sorted({eng.prefill_bucket_for(n, ENGINE_MIN_BUCKET,
                                            ENGINE_BLOCK, max_len)
                     for n in range(1, max_len + 1)})
-    budgets = {"step": 1, "fused_step": 1, "spec_step": 1,
+    budgets = {"step": 1, "fused_step": 1, "spec_step": 1, "promote": 1,
                "admit": len(brute) if not chunked else 0}
     report.signatures = {"enumerated": sigs, "budgets": budgets,
                          "brute_force_buckets": len(brute)}
@@ -565,7 +565,7 @@ def audit_decode_cell(preset: str, cfg: LLMConfig, recipe: str,
             "signature-enumeration", "error", "signatures", "admit",
             f"closed-form bucket set {sigs['buckets']} != brute-force "
             f"sweep over prompt lengths ({len(brute)} buckets)"))
-    for fam in ("step", "fused_step", "admit", "spec_step"):
+    for fam in ("step", "fused_step", "admit", "spec_step", "promote"):
         if sigs[fam] > budgets[fam]:
             report.findings.append(Finding(
                 "trace-budget", "error", "signatures", fam,
@@ -623,6 +623,24 @@ def audit_decode_cell(preset: str, cfg: LLMConfig, recipe: str,
         don = donation_report(spec_tr)
         report.donation["spec_step"] = don
         _donation_findings(report, "spec_step", don)
+        # host-tier promote copy program (ISSUE 17, ops/kv_tier.py):
+        # EXACTLY ONE audited program stages any demoted chain back into
+        # HBM — fixed (block_size, ...) row shapes per cache leaf plus a
+        # scalar block id — and the pool buffers are donated so the
+        # promotion recycles the cache allocation in place (the TPU
+        # contract; the engine skips donation on CPU). The demote side
+        # is a device_get, not a program — nothing to trace.
+        from distributed_pytorch_tpu.ops import kv_tier
+        rows = jax.tree_util.tree_map(
+            lambda pool: jax.ShapeDtypeStruct(pool.shape[1:], pool.dtype),
+            caches)
+        promote_tr = jax.jit(kv_tier.make_promote_block_fn(),
+                             donate_argnums=(0,)).trace(
+            caches, rows, jax.ShapeDtypeStruct((), i32))
+        inv += collective_inventory(promote_tr)
+        don = donation_report(promote_tr)
+        report.donation["promote"] = don
+        _donation_findings(report, "promote", don)
         if chunked:
             ctoks = jax.ShapeDtypeStruct((1, chunk), i32)
             clen = jax.ShapeDtypeStruct((1,), i32)
@@ -875,6 +893,7 @@ def format_report(r: CommsReport) -> str:
         lines.append(f"  signatures: step={sig['step']} "
                      f"fused={sig['fused_step']} admit={sig['admit']} "
                      f"spec={sig.get('spec_step', 0)} "
+                     f"promote={sig.get('promote', 0)} "
                      f"(budgets {r.signatures['budgets']})")
     for f in r.findings:
         lines.append(f"  [{f.severity.upper()}] {f.rule} "

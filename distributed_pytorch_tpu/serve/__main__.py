@@ -61,6 +61,12 @@ def build_args(argv=None):
     p.add_argument("--no-prefix-cache", dest="prefix_cache",
                    action="store_false",
                    help="disable radix prefix reuse (A/B baseline)")
+    p.add_argument("--kv-host-gb", "--kv_host_gb", dest="kv_host_gb",
+                   type=float, default=None,
+                   help="host-RAM KV tier budget in GiB — priced into "
+                        "whole blocks via train.memplan (scale sidecars "
+                        "included for an int8 cache) and enables the "
+                        "tier; overrides the KV_HOST_BLOCKS knob")
     p.add_argument("--cpu", action="store_true",
                    help="pin the CPU backend via a live jax.config update "
                         "(env vars are too late on images whose "
@@ -127,6 +133,21 @@ async def _amain(args) -> None:
         recipe = train_cfg.parallelism if mesh is not None else "single"
         encoder = _encoder()
 
+    # --kv-host-gb prices a host-RAM tier budget into whole KV blocks
+    # with the planner's bytes-per-token model (train/memplan.py) and
+    # turns the tier on; None falls through to the KV_HOST_TIER /
+    # KV_HOST_BLOCKS knobs inside the engine
+    host_tier = None
+    host_blocks = None
+    if args.kv_host_gb is not None:
+        from distributed_pytorch_tpu.train.memplan import \
+            host_tier_blocks_for_gb
+        host_blocks = host_tier_blocks_for_gb(
+            model.config, args.kv_host_gb,
+            block_size=args.kv_block or 16,
+            cache_dtype_size=1 if args.cache_dtype == "int8" else 2)
+        host_tier = host_blocks > 0
+
     eng = DecodeEngine(model, variables, n_slots=args.slots,
                        cache_dtype=args.cache_dtype or None,
                        quantize_weights=args.quant_weights,
@@ -136,7 +157,8 @@ async def _amain(args) -> None:
                        mesh=mesh, recipe=recipe,
                        block_size=args.kv_block, n_blocks=args.kv_blocks,
                        prefix_cache=args.prefix_cache,
-                       prefill_chunk=args.prefill_chunk)
+                       prefill_chunk=args.prefill_chunk,
+                       host_tier=host_tier, host_blocks=host_blocks)
     sched = Scheduler(eng, max_queue=args.max_queue,
                       default_deadline_s=args.deadline_s)
     # provenance labels for /metrics scrapes and bench JSON (the engine

@@ -36,6 +36,12 @@ LATENCY_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
 # largest plausible chunk budget — the knob this histogram tunes.
 PREFILL_TOKEN_BUCKETS = (0, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096)
 
+# Host-tier promote transport sizes (ops/kv_tier.py): pow4 byte grid from
+# one tiny block to tens of MB of chain — the bytes axis of the PERF.md
+# promote-cost model (bytes/PCIe-BW + device_put fixed cost).
+PROMOTE_BYTE_BUCKETS = (4096, 16384, 65536, 262144, 1048576,
+                        4194304, 16777216, 67108864)
+
 
 def engine_build_info(engine) -> dict:
     """The engine's serving-relevant config, for the build-info gauge:
@@ -331,10 +337,17 @@ class ServeMetrics:
     #: decoding ledger (engine/decode.py): tokens the n-gram drafter
     #: proposed vs tokens the verify step accepted — their ratio is the
     #: accepted_token_rate gauge the spec bench leg pins.
+    #: 'kv_tier_*_blocks' mirror the host-RAM KV tier's block movements
+    #: (ops/kv_tier.py, delta-synced by the scheduler): demoted =
+    #: evictions saved to host RAM, promoted = radix hits staged back
+    #: into HBM, dropped = lost to the host LRU cap — the only way
+    #: tier-managed KV is ever lost.
     COUNTERS = ("submitted", "admitted", "completed", "cancelled", "shed",
                 "failed", "tokens_out", "preempted", "requeued",
                 "prefix_hit_tokens", "prefix_miss_tokens",
-                "spec_drafted_tokens", "spec_accepted_tokens")
+                "spec_drafted_tokens", "spec_accepted_tokens",
+                "kv_tier_demoted_blocks", "kv_tier_promoted_blocks",
+                "kv_tier_dropped_blocks")
 
     def __init__(self):
         self._gauges: dict[str, tuple[Callable[[], float], str]] = {}
@@ -357,6 +370,13 @@ class ServeMetrics:
             "serve_prefill_tokens_per_step",
             "prefill tokens executed per fused step (chunked mode) or "
             "per admission (wave mode)", buckets=PREFILL_TOKEN_BUCKETS)
+        # host-tier promote transport (round 21): per-promotion byte
+        # sizes, the distribution the PERF.md promote-cost model is fit
+        # against — one sample per block chain staged host->HBM
+        self.kv_tier_promote_bytes = Histogram(
+            "serve_kv_tier_promote_bytes",
+            "bytes staged per host-tier->HBM chain promotion "
+            "(ops/kv_tier.py)", buckets=PROMOTE_BYTE_BUCKETS)
         self.decode_stall_s = 0.0
         self.register_gauge(
             "serve_decode_stall_ms", lambda: self.decode_stall_s * 1e3,
@@ -415,7 +435,7 @@ class ServeMetrics:
     # ------------------------------------------------------------------
     def _histograms(self) -> tuple:
         return (self.ttft, self.itl, self.e2e, self.queue_wait,
-                self.prefill_tokens_per_step)
+                self.prefill_tokens_per_step, self.kv_tier_promote_bytes)
 
     def snapshot(self) -> dict:
         """JSON-serializable state for `GET /metrics.json` — everything
@@ -473,6 +493,12 @@ class ServeMetrics:
                   f"{self.counters['spec_drafted_tokens']}",
                   f'serve_spec_tokens_total{{kind="accepted"}} '
                   f"{self.counters['spec_accepted_tokens']}"]
+        for ev in ("demoted", "promoted", "dropped"):
+            name = f"kv_tier_{ev}_blocks_total"
+            lines += [f"# HELP {name} host-RAM KV tier blocks {ev} "
+                      "(ops/kv_tier.py)",
+                      f"# TYPE {name} counter",
+                      f"{name} {self.counters[f'kv_tier_{ev}_blocks']}"]
         for cause, n in sorted(self.shed_counts.items()):
             lines.append(f'serve_shed_total{{cause="{cause}"}} {n}')
         for reason, n in sorted(self.retire_counts.items()):
@@ -534,9 +560,13 @@ class RouterMetrics:
     here: every submitted request is completed + shed (nothing silently
     failed)."""
 
+    #: 'sticky_hits' counts dispatches whose replica was chosen by
+    #: radix-digest prefix affinity (cache-aware routing) rather than
+    #: pure least-loaded — the fleet-wide prefix reuse the tier bench
+    #: leg's 2-replica drive pins.
     COUNTERS = ("submitted", "dispatched", "completed", "shed",
                 "tokens_out", "failovers", "retries", "replica_down",
-                "replica_up", "replayed_tokens")
+                "replica_up", "replayed_tokens", "sticky_hits")
 
     def __init__(self):
         self._gauges: dict[str, tuple[Callable[[], float], str]] = {}
@@ -610,6 +640,11 @@ class RouterMetrics:
             lines.append(f'router_shed_total{{cause="{cause}"}} {n}')
         for rep, n in sorted(self.dispatch_counts.items()):
             lines.append(f'router_dispatch_total{{replica="{rep}"}} {n}')
+        lines += ["# HELP dispatch_sticky_hits_total dispatches routed "
+                  "by radix-digest prefix affinity (cache-aware pick)",
+                  "# TYPE dispatch_sticky_hits_total counter",
+                  f"dispatch_sticky_hits_total "
+                  f"{self.counters['sticky_hits']}"]
         lines += ["# HELP router_replica_transitions_total failure-"
                   "detector state transitions",
                   "# TYPE router_replica_transitions_total counter",

@@ -58,6 +58,8 @@ from typing import AsyncIterator, Optional
 from distributed_pytorch_tpu.config import knob
 from distributed_pytorch_tpu.obs import trace as obs_trace
 from distributed_pytorch_tpu.obs.slo import SLOTracker
+from distributed_pytorch_tpu.ops.block_pool import (ROOT_DIGEST,
+                                                    _child_digest)
 from distributed_pytorch_tpu.serve.metrics import (RouterMetrics,
                                                    render_fleet)
 from distributed_pytorch_tpu.serve.scheduler import ShedError
@@ -83,6 +85,27 @@ class ReplicaShed(RuntimeError):
 
 class NoReplica(RuntimeError):
     """No dispatchable replica (outside the current exclusion set)."""
+
+
+def prompt_chain_digests(prompt, block_size: int,
+                         max_depth: int = 64) -> list:
+    """Chain digests of the prompt's full blocks, DEEPEST FIRST — the
+    client-side half of the replicas' `kv_digest` advertisement. Depth d
+    digests the prompt's first d full blocks with exactly the fold the
+    engine's radix index uses (ops/block_pool.py), so a hex match at
+    depth d proves the replica has that whole prefix cached (HBM or
+    host tier). Deepest-first lets the sticky pick stop at the longest
+    advertised match."""
+    n = min(len(prompt) // block_size, max_depth) if block_size else 0
+    out = []
+    parent = ROOT_DIGEST
+    for i in range(n):
+        block = tuple(int(t)
+                      for t in prompt[i * block_size:(i + 1) * block_size])
+        parent = _child_digest(parent, block)
+        out.append((i + 1, parent.hex()))
+    out.reverse()
+    return out
 
 
 def _parse_addr(url: str) -> tuple[str, int]:
@@ -115,6 +138,11 @@ class Replica:
         self.last_err: Optional[str] = None
         self.metrics_snapshot: Optional[dict] = None  # last /metrics.json
         self.last_metrics_at = 0.0     # perf_counter of that pull
+        # radix-prefix advertisement from the last health probe: chain
+        # digest hex -> cached depth (blocks), plus the KV block size
+        # the digests were folded at — the sticky pick's match table
+        self.kv_digest: dict[str, int] = {}
+        self.digest_block_size = 0
 
     @property
     def dispatchable(self) -> bool:
@@ -267,6 +295,10 @@ class Router:
         rep.queue_depth = int(body.get("queue_depth", 0))
         rep.live_slots = int(body.get("live_slots", 0))
         rep.n_slots = int(body.get("n_slots", 0))
+        dig = body.get("kv_digest") or {}
+        rep.digest_block_size = int(dig.get("block_size", 0) or 0)
+        rep.kv_digest = {hx: int(depth)
+                         for depth, hx in dig.get("entries", [])}
         if status == 200:
             if rep.state != "healthy":
                 self.metrics.inc("replica_up")
@@ -320,14 +352,40 @@ class Router:
     # dispatch
     # ------------------------------------------------------------------
 
-    def pick(self, exclude: Optional[set] = None) -> Replica:
+    def pick(self, exclude: Optional[set] = None,
+             digests=None) -> Replica:
         """Least-loaded healthy replica outside `exclude`; round-robin
-        across ties so equal-load replicas share arrivals."""
+        across ties so equal-load replicas share arrivals.
+
+        `digests` (optional) is a callable mapping a KV block size to
+        the prompt's chain digests deepest-first (`prompt_chain_
+        digests`): when a candidate's advertised `kv_digest` matches
+        one, dispatch goes STICKY — the pool narrows to the replicas
+        with the longest digest match (their pools already hold that
+        prefix, HBM- or host-tier) and least-loaded breaks ties among
+        them, so fleet-wide prefix hit rate stops depending on which
+        replica an arrival happened to land on. No match (or no
+        advertisement) degrades to the plain least-loaded pick."""
         pool = [r for r in self.replicas.values()
                 if r.dispatchable and (not exclude or r.name not in exclude)]
         if not pool:
             raise NoReplica("no healthy replica"
                             + (" outside the tried set" if exclude else ""))
+        if digests is not None:
+
+            def _affinity(rep: Replica) -> int:
+                if not rep.kv_digest or not rep.digest_block_size:
+                    return 0
+                for depth, hx in digests(rep.digest_block_size):
+                    if hx in rep.kv_digest:
+                        return depth
+                return 0
+
+            scores = {r.name: _affinity(r) for r in pool}
+            best_depth = max(scores.values())
+            if best_depth > 0:
+                pool = [r for r in pool if scores[r.name] == best_depth]
+                self.metrics.inc("sticky_hits")
         best = min(r.load for r in pool)
         ties = [r for r in pool if r.load == best]
         self._rr += 1
@@ -363,6 +421,15 @@ class Router:
         tried: set[str] = set()
         last_tok_at: Optional[float] = None
         last_cause, last_msg = "no_replica", "no healthy replica"
+        # cache-aware dispatch: the prompt's chain digests, computed
+        # lazily once per advertised block size (one size fleet-wide in
+        # practice) and matched against replicas' kv_digest tables
+        _digest_memo: dict[int, list] = {}
+
+        def _digests(bs: int) -> list:
+            if bs not in _digest_memo:
+                _digest_memo[bs] = prompt_chain_digests(prompt, bs)
+            return _digest_memo[bs]
 
         def _end_request(outcome: str, now: Optional[float] = None):
             tr.add("router.request", tid,
@@ -373,7 +440,7 @@ class Router:
 
         while True:
             try:
-                rep = self.pick(exclude=tried)
+                rep = self.pick(exclude=tried, digests=_digests)
             except NoReplica:
                 self.metrics.shed(last_cause)
                 _end_request(f"shed:{last_cause}")

@@ -280,6 +280,21 @@ class Scheduler:
             "serve_engine_tokens_per_step",
             lambda: getattr(self.engine, "tokens_per_step", 1.0),
             "mean tokens emitted per fused step (spec decode > 1)")
+        # host-RAM KV tier (ops/kv_tier.py via engine.host_tier): live
+        # occupancy/save-rate gauges here, block-movement counters
+        # delta-synced in _tier_sync() after every engine call. Tier
+        # promotes run inside admit() — BEFORE queue_wait is observed —
+        # so promote latency lands in queue-wait, never in ITL.
+        self.metrics.register_gauge(
+            "serve_kv_host_tier_occupancy",
+            lambda: getattr(self.engine, "host_tier_occupancy", 0.0),
+            "resident fraction of the host-RAM KV tier's block budget")
+        self.metrics.register_gauge(
+            "serve_kv_host_tier_hit_rate",
+            lambda: getattr(self.engine, "host_tier_hit_rate", 0.0),
+            "fraction of tier probes (after an HBM radix miss) served "
+            "from host RAM")
+        self._tier_seen = {"demoted": 0, "promoted": 0, "dropped": 0}
         # provenance: the engine's serving-relevant config as a
         # Prometheus info gauge (and in the bench JSON via summary())
         self.metrics.set_build_info(**engine_build_info(engine))
@@ -605,6 +620,24 @@ class Scheduler:
             else:
                 self._live[adm.seq_id] = req
 
+    def _tier_sync(self) -> None:
+        """Fold the engine host tier's lifetime counters into the
+        metrics registry as deltas and drain per-promotion byte sizes
+        into the promote-bytes histogram. Runs on the event loop right
+        after an engine call returns from the executor — the tier only
+        mutates inside admit/step, so the read races nothing."""
+        tier = getattr(self.engine, "host_tier", None)
+        if tier is None:
+            return
+        counts = tier.counters()
+        for k in ("demoted", "promoted", "dropped"):
+            delta = counts[k] - self._tier_seen[k]
+            if delta:
+                self.metrics.inc(f"kv_tier_{k}_blocks", delta)
+                self._tier_seen[k] = counts[k]
+        for nbytes in tier.drain_promote_events():
+            self.metrics.kv_tier_promote_bytes.observe(float(nbytes))
+
     def _finish(self, req: _Request, ret: Retired, now: float) -> None:
         self.metrics.inc("completed")
         self.metrics.retired(ret.reason)
@@ -655,6 +688,7 @@ class Scheduler:
                 if self._stopping:
                     break
                 await self._admit_wave(loop)
+                self._tier_sync()      # admits demote (preempt) + promote
                 if not self._live:
                     if not self._queue:        # idle: park until work
                         self._wake.clear()
@@ -673,6 +707,7 @@ class Scheduler:
                 res = await loop.run_in_executor(self._exec,
                                                  self.engine.step)
                 now = time.perf_counter()
+                self._tier_sync()      # steps demote via _ensure_blocks
                 if getattr(self.engine, "prefill_chunk", 0):
                     # per-step chunk budget use: the chunk-size tuning
                     # signal (p50 ~ budget => prefill-bound, ~0 => slack)

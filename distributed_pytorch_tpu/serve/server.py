@@ -256,6 +256,13 @@ class ServeApp:
             body["drained"] = sched.drained
         if sched.failed is not None:
             body["failed"] = str(sched.failed)
+        # cache-aware routing: the replica's radix-prefix digest (top-k
+        # chain digests by cached depth, HBM or host tier) rides the
+        # health probe so the router can dispatch sticky-by-prefix —
+        # no extra poll, no extra endpoint
+        digest = getattr(eng, "kv_digest", None)
+        if callable(digest):
+            body["kv_digest"] = digest()
         return _json_response(200 if ready else 503, body)
 
     def _debug_trace(self, path: str, query: dict) -> bytes:

@@ -238,7 +238,9 @@ def estimate_serving_gb(model_cfg: LLMConfig, n_slots: int, max_len: int, *,
                         quantize_weights: bool = False,
                         compute_dtype_size: int = 2,
                         n_params: Optional[int] = None,
-                        n_slots_acts: Optional[int] = None
+                        n_slots_acts: Optional[int] = None,
+                        host_tier_blocks: int = 0,
+                        host_tier_block_size: int = 16
                         ) -> tuple[float, dict]:
     """Serving-memory estimate for one chip running the DecodeEngine:
     the bf16 serving weights (prefill always needs them), the int8 decode
@@ -246,8 +248,11 @@ def estimate_serving_gb(model_cfg: LLMConfig, n_slots: int, max_len: int, *,
     (n_slots, max_len) KV cache at its true itemsize (+ the f32 scale
     sidecars for an int8 cache, cache_dtype_size=1), and a small
     activation term — so slot counts can be planned per chip instead of
-    OOM-bisected on hardware. Closed-form + jax.eval_shape only, like
-    plan_memory."""
+    OOM-bisected on hardware. `host_tier_blocks` adds a 'host_kv_tier'
+    breakdown row pricing the host-RAM KV tier (ops/kv_tier.py) at the
+    same bytes-per-block as the pool — reported so the tier budget is
+    sized from host RAM, but NEVER summed into the HBM total. Closed-form
+    + jax.eval_shape only, like plan_memory."""
     from distributed_pytorch_tpu.train import metrics as M
 
     P = n_params if n_params is not None else param_count(model_cfg)
@@ -271,8 +276,29 @@ def estimate_serving_gb(model_cfg: LLMConfig, n_slots: int, max_len: int, *,
         "kv_cache": cache_b / 2 ** 30,
         "acts": act_b / 2 ** 30,
     }
+    # total sums HBM terms only — the host tier row is added after
     total = sum(breakdown.values()) * _FUDGE
+    if host_tier_blocks:
+        breakdown["host_kv_tier"] = (
+            host_tier_blocks * host_tier_block_size
+            * M.kv_bytes_per_token(model_cfg, cache_dtype_size,
+                                   kv_scales=cache_dtype_size == 1)
+            / 2 ** 30)
     return total, {k: round(v, 3) for k, v in breakdown.items()}
+
+
+def host_tier_blocks_for_gb(model_cfg: LLMConfig, gb: float, *,
+                            block_size: int = 16,
+                            cache_dtype_size: int = 2) -> int:
+    """Price a `--kv-host-gb` budget into whole KV blocks with the same
+    bytes-per-token model the HBM pool planner uses (f32 scale sidecars
+    included for an int8 cache) — the number the serve CLI feeds the
+    engine as its host-tier budget (KV_HOST_BLOCKS)."""
+    from distributed_pytorch_tpu.train import metrics as M
+
+    block_b = block_size * M.kv_bytes_per_token(
+        model_cfg, cache_dtype_size, kv_scales=cache_dtype_size == 1)
+    return max(0, int(gb * 2 ** 30 // block_b))
 
 
 def plan_decode_blocks(model_cfg: LLMConfig, max_len: int, *,
@@ -281,7 +307,9 @@ def plan_decode_blocks(model_cfg: LLMConfig, max_len: int, *,
                        cache_dtype_size: int = 2,
                        quantize_weights: bool = False,
                        n_slots_hint: Optional[int] = None,
-                       max_blocks: int = 2 ** 20) -> int:
+                       max_blocks: int = 2 ** 20,
+                       host_tier_blocks: int = 0,
+                       verbose: bool = False) -> int:
     """Block-budget planner for the PAGED decode engine: how many KV
     blocks of `block_size` rows fit the per-chip HBM after the serving
     weights (+ the int8 decode copy) and a slot-count-shaped activation
@@ -290,7 +318,10 @@ def plan_decode_blocks(model_cfg: LLMConfig, max_len: int, *,
     knob should get; `n_slots_hint` (default: pool rows / max_len, i.e.
     worst-case sequences) only sizes the small activation estimate.
     Returns 0 when the weights alone don't fit — the model needs
-    sharding. Closed-form + jax.eval_shape only, like plan_memory."""
+    sharding. `verbose` prints the HBM-vs-host cache split when a
+    host-RAM tier rides behind the pool (`host_tier_blocks`,
+    ops/kv_tier.py), so an over-HBM bench pool is priced, not guessed.
+    Closed-form + jax.eval_shape only, like plan_memory."""
     from distributed_pytorch_tpu.train import metrics as M
 
     budget_b = (hbm_gb if hbm_gb is not None else device_hbm_gb()) * 2 ** 30
@@ -315,6 +346,15 @@ def plan_decode_blocks(model_cfg: LLMConfig, max_len: int, *,
     while lo + 1 < hi:                      # bisect the last doubling
         mid = (lo + hi + 1) // 2
         lo, hi = (mid, hi) if fits(mid) else (lo, mid)
+    if verbose:
+        hbm_cache_gb = block_b * lo / 2 ** 30
+        host_gb = block_b * host_tier_blocks / 2 ** 30
+        eff = (lo + host_tier_blocks) / lo
+        print(f"[kv plan] pool {lo} blocks ({hbm_cache_gb:.2f} GiB HBM)"
+              f" + host tier {host_tier_blocks} blocks"
+              f" ({host_gb:.2f} GiB host RAM)"
+              f" = {lo + host_tier_blocks} cacheable blocks"
+              f" ({eff:.1f}x HBM)")
     return lo
 
 

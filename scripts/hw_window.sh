@@ -11,7 +11,10 @@
 #                            baseline — and the round-20 serve_load_spec
 #                            leg: speculative decoding BENCH_SPEC_K 2/4
 #                            vs the spec-off baseline on the same seeded
-#                            arrivals; worst case ~75 min if the tunnel
+#                            arrivals, and the round-21 serve_load_tier
+#                            leg: host-RAM KV tier on/off with the HBM
+#                            pool clamped to 0.1x working set, same
+#                            seeded arrivals; worst case ~75 min if the tunnel
 #                            goes half-up mid-bench, so the cap is 90 min —
 #                            bench always prints its JSON line if allowed
 #                            to finish)

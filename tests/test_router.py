@@ -528,3 +528,56 @@ def test_router_metrics_render_smoke():
     s = m.summary()
     assert s["dispatch_by_replica"] == {"127.0.0.1:1": 1}
     assert s["shed_by_cause"] == {"no_replica": 1}
+
+
+# ----------------------------------------------------------------------
+# cache-aware (digest-sticky) dispatch
+# ----------------------------------------------------------------------
+
+def test_sticky_dispatch_follows_prefix_digest(mv):
+    """Requests sharing a multi-block prefix concentrate on the replica
+    whose advertised radix digest matches, instead of spreading
+    least-loaded — and the streams stay bit-identical to offline greedy.
+    Unrelated prompts keep plain least-loaded dispatch (no sticky hit).
+    """
+    sys_prompt = [(7 * i + 3) % 97 for i in range(24)]   # 3 blocks @ bs 8
+    tails = [5, 8, 11, 14]
+    prompts = [sys_prompt + [t] for t in tails]
+    other = [90, 91, 92]                                  # sub-block
+
+    async def main():
+        reps = [await Rep(mv).start() for _ in range(2)]
+        router = make_router(*reps)
+        await router.start()
+        first = await router.complete(prompts[0], 3)
+        # let a probe cycle pick up the serving replica's digest advert
+        for _ in range(40):
+            await asyncio.sleep(0.05)
+            if any(r.kv_digest for r in router.replicas.values()):
+                break
+        served = [i for i, r in enumerate(reps)
+                  if r.sched.metrics.counters["admitted"] > 0]
+        outs = [await router.complete(p, 3) for p in prompts[1:]]
+        plain = await router.complete(other, 3)
+        admitted = [r.sched.metrics.counters["admitted"] for r in reps]
+        await router.stop()
+        for r in reps:
+            await r.stop()
+        return router, first, served, outs, plain, admitted
+
+    (router, first, served, outs, plain,
+     admitted) = run_async(main(), timeout=120)
+    # exactly one replica served the first request, and every
+    # same-prefix follow-up stuck to it
+    assert len(served) == 1
+    assert admitted[served[0]] >= len(prompts)
+    assert router.metrics.counters["sticky_hits"] >= len(prompts) - 1
+    # the advertisement round-tripped the health probe
+    rep = list(router.replicas.values())
+    assert any(r.digest_block_size > 0 and r.kv_digest for r in rep)
+    # parity: sticky routing never changes tokens
+    refs = offline_ref(mv, prompts + [other], [3] * 5)
+    for p, out, ref in zip(prompts, [first] + outs, refs):
+        assert out["tokens"] == ref[len(p):], f"diverged for tail {p[-1]}"
+    assert plain["tokens"] == refs[-1][len(other):]
+    assert plain["reason"] == "budget"

@@ -1166,6 +1166,161 @@ def _serve_spec_bench(platform: str) -> dict:
             "preset": preset}
 
 
+def _serve_spinup_bench(platform: str) -> dict:
+    """serve_spinup leg (BENCH_SERVE=1 BENCH_SERVE_SPINUP=1): the AOT
+    program-store A/B (ISSUE 18). Measures replica start -> first token
+    twice over the same greedy prompt: store off (every program traces
+    and compiles inside the window) vs warmed (a second engine reads
+    every program from a store a first engine populated — the zero-
+    cold-start replica add). A train sub-leg restarts the tiny train
+    config cold vs against the warmed store and reports restart ->
+    first-step, the supervisor re-mesh case (reported, not asserted:
+    subprocess wall time includes interpreter+import noise). Acceptance
+    booleans the ISSUE pins: warm_faster (warmed TTFT beats cold),
+    hit_rate_1 (the warmed window reads every program from the store —
+    zero misses, zero JIT traces), parity (greedy output bit-identical
+    cold vs warmed)."""
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from distributed_pytorch_tpu.config import LLMConfig, flagship_gpt124m
+    from distributed_pytorch_tpu.engine import DecodeEngine
+    from distributed_pytorch_tpu.models.gpt import LLM
+    from distributed_pytorch_tpu.parallel.aot_store import AOTStore
+
+    try:
+        # run_bench points the persistent XLA cache at /tmp for repeat
+        # invocations — that would hand the "cold" leg pre-built
+        # binaries. This leg measures compile cost; turn it off.
+        jax.config.update("jax_compilation_cache_dir", None)
+    except Exception:
+        pass
+
+    n_dev = len(jax.devices())
+    if platform == "tpu":
+        cfg = flagship_gpt124m()
+        S = int(os.environ.get("BENCH_DECODE_LEN", "1024"))
+        slots = int(os.environ.get("BENCH_DECODE_SLOTS", "8"))
+        kv_block = int(os.environ.get("BENCH_KV_BLOCK", "128"))
+        dtype = jnp.bfloat16
+        preset = "gpt2_124m"
+    else:  # CPU proxy: tiny model, same program set
+        cfg = LLMConfig(vocab_size=1024, block_size=128, n_embd=128,
+                        n_head=4, n_kv_heads=4, attn="mha", n_layer=2,
+                        up_dim=256, non_linearity="swiglu", pos_emb="rope")
+        S, slots, dtype = 128, 4, jnp.float32
+        kv_block = int(os.environ.get("BENCH_KV_BLOCK", "16"))
+        preset = "cpu_tiny"
+    model = LLM(cfg, compute_dtype=dtype, attn_impl="auto")
+    rng = jax.random.PRNGKey(0)
+    dummy = jnp.zeros((1, cfg.block_size), jnp.int32)
+    variables = jax.jit(model.init)({"params": rng, "dropout": rng},
+                                    dummy, dummy)
+    prompt = [(7 * i + 3) % cfg.vocab_size for i in range(24)]
+    budget = 16
+
+    def spin(store):
+        """start -> first token with the given store (False = off);
+        returns (ttft_s, total_s, full greedy stream, engine)."""
+        t0 = time.perf_counter()
+        e = DecodeEngine(model, variables, n_slots=slots, max_len=S,
+                         temperature=0.0, block_size=kv_block,
+                         aot_store=store)
+        if e.aot_store is not None:
+            e.warm_aot(origin="runtime")  # the replica spin-up path
+        adm = e.admit(list(prompt), budget)
+        sid = adm.seq_id
+        # wave-mode prefill samples the first token inside admit itself
+        toks: list = ([] if adm.first_token is None
+                      else [int(adm.first_token)])
+        while not toks:
+            toks += e.step().emitted.get(sid, [])
+        ttft = time.perf_counter() - t0
+        while e.n_live:
+            toks += e.step().emitted.get(sid, [])
+        return ttft, time.perf_counter() - t0, toks, e
+
+    ttft_cold, total_cold, toks_cold, _ = spin(False)
+
+    root = tempfile.mkdtemp(prefix="bench_aot_")
+    try:
+        populate = DecodeEngine(model, variables, n_slots=slots,
+                                max_len=S, temperature=0.0,
+                                block_size=kv_block,
+                                aot_store=AOTStore(root))
+        populate.warm_aot(origin="warm")  # outside every window
+        warm_store = AOTStore(root)  # fresh counters for the ledger
+        ttft_warm, total_warm, toks_warm, e_warm = spin(warm_store)
+        warm_traces = (e_warm.step_traces + e_warm.fused_step_traces
+                       + e_warm.spec_step_traces + e_warm.promote_traces
+                       + sum(e_warm.admit_traces.values()))
+
+        # train sub-leg: restart -> first-step, cold store vs warmed
+        # (the supervisor re-mesh pre-warm case). Subprocesses so each
+        # restart pays real import+trace cost; CPU pin — the parent may
+        # hold the TPU.
+        train_root = os.path.join(root, "train")
+        targv = [sys.executable, "-m", "distributed_pytorch_tpu",
+                 "--dataset", "synthetic", "--platform", "cpu",
+                 "--parallelism", "single", "--file_name", "bench_aot",
+                 "--seed", "7", "--max_iters", "1", "--log_interval", "1",
+                 "--total_batch_size_str", "64", "--batch_size", "1",
+                 "--vocab_size", "256", "--block_size", "32",
+                 "--n_embd", "32", "--n_head", "4", "--n_kv_heads", "2",
+                 "--n_layer", "2", "--up_dim", "48"]
+        tenv = {**os.environ, "JAX_PLATFORMS": "cpu", "AOT_STORE": "on",
+                "AOT_STORE_DIR": train_root}
+
+        def train_once():
+            t0 = time.perf_counter()
+            p = subprocess.run(targv, env=tenv, capture_output=True,
+                               text=True, timeout=600)
+            hit = "aot store: train_step hit" in (p.stdout + p.stderr)
+            return round(time.perf_counter() - t0, 2), hit, p.returncode
+
+        train = {}
+        try:
+            cold_s, _, rc0 = train_once()
+            warm_s, warm_hit, rc1 = train_once()
+            train = {"restart_cold_s": cold_s, "restart_warm_s": warm_s,
+                     "warm_hit": warm_hit, "rc": [rc0, rc1]}
+        except (subprocess.TimeoutExpired, OSError) as exc:
+            train = {"error": type(exc).__name__}
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    accept = {
+        # the ISSUE 18 acceptance booleans
+        "spinup_warm_faster": ttft_warm < ttft_cold,
+        "spinup_hit_rate_1": (warm_store.misses == 0
+                              and warm_store.hits > 0
+                              and warm_traces == 0),
+        "spinup_parity": toks_warm == toks_cold}
+    return {"metric": ("serve_spinup_ttft_cold_over_warm"
+                       if platform == "tpu"
+                       else "cpu_proxy_serve_spinup_ttft_cold_over_warm"),
+            "value": round(ttft_cold / max(ttft_warm, 1e-9), 2),
+            "unit": "x", "accept": accept,
+            "ttft_cold_s": round(ttft_cold, 3),
+            "ttft_warm_s": round(ttft_warm, 3),
+            "total_cold_s": round(total_cold, 3),
+            "total_warm_s": round(total_warm, 3),
+            "store": {"hits": warm_store.hits,
+                      "misses": warm_store.misses,
+                      "load_ms": round(warm_store.load_ms, 1),
+                      "compile_ms": round(warm_store.compile_ms, 1)},
+            "warm_traces": warm_traces, "train_restart": train,
+            "n_tokens": len(toks_cold), "n_slots": slots,
+            "cache_len": S, "kv_block": kv_block, "n_chips": n_dev,
+            "device": jax.devices()[0].device_kind, "preset": preset}
+
+
 def _serve_router_bench(platform: str) -> dict:
     """serve_load_router leg (BENCH_SERVE=1 BENCH_SERVE_ROUTER=1): the
     replicated-serving fault-tolerance A/B. Delegates to the
@@ -1277,6 +1432,8 @@ def run_bench(platform: str, only_recipe: str | None = None) -> dict:
             return _serve_chunked_bench(platform)
         if os.environ.get("BENCH_SERVE_SPEC"):
             return _serve_spec_bench(platform)
+        if os.environ.get("BENCH_SERVE_SPINUP"):
+            return _serve_spinup_bench(platform)
         if os.environ.get("BENCH_SERVE_TIER"):
             return _serve_tier_bench(platform)
         return _serve_bench(platform)
@@ -1600,6 +1757,13 @@ def main() -> None:
                     # accept booleans)
                     ("serve_load_tier",
                      {"BENCH_SERVE": "1", "BENCH_SERVE_TIER": "1",
+                      "FLASH_DECODE": "on"}),
+                    # ISSUE 18: AOT program store — replica start ->
+                    # first-token cold vs warmed from the store, plus the
+                    # train restart sub-leg (warm-faster / hit-rate-1 /
+                    # greedy-parity accept booleans)
+                    ("serve_spinup",
+                     {"BENCH_SERVE": "1", "BENCH_SERVE_SPINUP": "1",
                       "FLASH_DECODE": "on"}),
                     # PR 8: replicated serving behind the fault-tolerant
                     # router — 3 replica processes, one SIGKILLed

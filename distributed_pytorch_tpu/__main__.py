@@ -20,6 +20,20 @@ from distributed_pytorch_tpu.config import (PRESETS, build_parser,
                                             configs_from_args, knobs_table)
 
 
+def parse_train_argv(argv):
+    """(model_cfg, train_cfg) from a train command line, with the same
+    preset re-parse `main` applies — the AOT pre-warm path
+    (parallel/aot_store.py) resolves the exact configs a supervised
+    worker would train under from its stored argv."""
+    args = build_parser().parse_args(argv)
+    model_defaults = None
+    if args.preset:
+        # re-parse against the preset's defaults so explicit flags win
+        model_defaults = PRESETS[args.preset]()
+        args = build_parser(model_defaults=model_defaults).parse_args(argv)
+    return configs_from_args(args, model_defaults=model_defaults)
+
+
 def main(argv=None) -> None:
     args = build_parser().parse_args(argv)
     if args.knobs:
@@ -27,13 +41,7 @@ def main(argv=None) -> None:
         # so this works anywhere the package installs
         print(knobs_table())
         return
-    model_defaults = None
-    if args.preset:
-        # re-parse against the preset's defaults so explicit flags win
-        model_defaults = PRESETS[args.preset]()
-        args = build_parser(model_defaults=model_defaults).parse_args(argv)
-    model_cfg, train_cfg = configs_from_args(args,
-                                             model_defaults=model_defaults)
+    model_cfg, train_cfg = parse_train_argv(argv)
 
     if train_cfg.platform != "auto":
         # Pin the backend BEFORE any jax device op. Env vars are not enough

@@ -227,6 +227,21 @@ register_knob("COMMSCHECK_DEVICES", "8", int,
               "touching a backend (compat.request_cpu_devices); the "
               "default fits the 4x2 matrix meshes")
 
+# --- AOT program store (parallel/aot_store.py, ISSUE 18) ---
+register_knob("AOT_STORE", "auto",
+              lambda s: _onoff(s) if s.strip() else "auto",
+              "AOT-compiled program store gate: on | off | auto (auto = "
+              "on iff AOT_STORE_DIR is set); hit = deserialize a stored "
+              "executable, miss = JIT + write back")
+register_knob("AOT_STORE_DIR", "", str,
+              "AOT store directory (empty with AOT_STORE=on defaults to "
+              "runs/aot_store); one .bin executable + .json manifest per "
+              "content-addressed program key")
+register_knob("AOT_STRICT", "off", lambda s: s.strip().lower() or "off",
+              "AOT store miss handling: off (silent JIT fallback) | warn "
+              "(log each compile) | require (raise — CI mode proving "
+              "zero cold-start compiles)")
+
 
 ACTIVATIONS = (
     "relu", "gelu", "swish", "mish", "silu", "selu", "celu", "elu",

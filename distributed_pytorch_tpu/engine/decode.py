@@ -489,7 +489,8 @@ class DecodeEngine:
                  spec_k: Optional[int] = None,
                  host_tier: Optional[bool] = None,
                  host_blocks: Optional[int] = None,
-                 flight_capacity: int = 4096):
+                 flight_capacity: int = 4096,
+                 aot_store=None):
         cfg = model.config
         self.model = model
         self.cfg = cfg
@@ -670,6 +671,18 @@ class DecodeEngine:
                 budget=1 if self.host_tier is not None else 0),
         }
         self.admit_traces: dict[int, int] = {}  # bucket -> trace count
+        # AOT program store (parallel/aot_store.py, ISSUE 18): every
+        # compiled-family getter routes through _build_aot — hit means a
+        # deserialized executable and NO trace (the guards above stay at
+        # 0 on a warmed spin-up), miss compiles as usual and writes
+        # back. None (the default with the AOT_STORE knob off) keeps the
+        # plain JIT path byte-for-byte.
+        if aot_store is None:
+            from distributed_pytorch_tpu.parallel.aot_store import \
+                resolve_store
+            aot_store = resolve_store()
+        self.aot_store = aot_store or None   # False = explicitly off
+        self._aot_origin = "runtime"
         # lifetime counters — the stable occupancy/accounting surface a
         # scheduler reads instead of poking _slots
         self.n_admitted = 0
@@ -713,12 +726,135 @@ class DecodeEngine:
         self.block_tables = bt
         self._tables_dirty = False
 
+    # -- AOT program store (parallel/aot_store.py, ISSUE 18) ------------
+
+    def _sds_leaf(self, leaf):
+        sh = leaf.sharding if (self._mesh is not None
+                               and hasattr(leaf, "sharding")) else None
+        return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=sh)
+
+    def _aot_avals(self, family: str, bucket: Optional[int] = None):
+        """The exact call-site avals of one compiled family, derived
+        from the live engine state (so store keys match between a
+        warming process and a serving replica by construction)."""
+        sds = lambda t: jax.tree_util.tree_map(self._sds_leaf, t)
+        s32 = jax.ShapeDtypeStruct((), jnp.int32)
+        if family == "admit":
+            return (sds(self.variables), sds(self.caches), sds(self.tok),
+                    sds(self.pos), sds(self.live),
+                    self._sds_leaf(self.block_tables),
+                    jax.ShapeDtypeStruct((1, bucket), jnp.int32), s32,
+                    jax.ShapeDtypeStruct((1,), jnp.int32), s32,
+                    sds(self._rng))
+        if family == "promote":
+            rows = jax.tree_util.tree_map(
+                lambda c: jax.ShapeDtypeStruct(c.shape[1:], c.dtype),
+                self.caches)
+            return (sds(self.caches), rows, s32)
+        base = (sds(self.variables), sds(self.caches), sds(self.tok),
+                sds(self.pos), sds(self.live),
+                self._sds_leaf(self.block_tables), sds(self._rng), s32,
+                sds(self._qparams))
+        if family == "fused_step":
+            return base + (
+                jax.ShapeDtypeStruct((1, self.prefill_chunk), jnp.int32),
+                s32, s32, jax.ShapeDtypeStruct((1,), jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.bool_))
+        if family == "spec_step":
+            return base + (
+                jax.ShapeDtypeStruct((self.n_slots, self.spec_k),
+                                     jnp.int32),
+                jax.ShapeDtypeStruct((self.n_slots,), jnp.int32))
+        assert family == "step", family
+        return base
+
+    def _aot_env(self, family: str,
+                 bucket: Optional[int] = None) -> dict:
+        """Program-identity env for store keys AND the crosscheck's
+        geometry record (aot_store.crosscheck re-enumerates the static
+        program universe from this)."""
+        env = {
+            "kind": "engine",
+            "model_cfg": dataclasses.asdict(self.cfg),
+            "geometry": {
+                "n_slots": self.n_slots, "max_len": self.max_len,
+                "min_bucket": self.min_bucket,
+                "block_size": self.block_size,
+                "n_blocks": self.n_blocks,
+                "table_width": self.table_width,
+                "prefill_chunk": self.prefill_chunk,
+                "spec_k": self.spec_k if self.spec_decode else 0,
+                "host_tier": self.host_tier is not None,
+                "cache_dtype": jnp.dtype(self.cache_dtype).name,
+                "weights_quantized": self.weights_quantized,
+                "temperature": self.temperature, "top_k": self.top_k,
+                "recipe": self._recipe,
+                "mesh": (dict(zip(self._mesh.axis_names,
+                                  [int(x) for x in
+                                   self._mesh.devices.shape]))
+                         if self._mesh is not None else None),
+            },
+        }
+        if bucket is not None:
+            env["bucket"] = int(bucket)
+        return env
+
+    def _build_aot(self, family: str, jitted,
+                   bucket: Optional[int] = None):
+        """Route one compiled family through the AOT store: hit =
+        deserialized executable (no trace), miss = lower+compile NOW
+        (the guard marks, exactly like a cold first call) + write-back.
+        Store off: the jitted fn passes through untouched."""
+        if self.aot_store is None:
+            return jitted
+        from distributed_pytorch_tpu.parallel.aot_store import \
+            SafeCompiled
+        avals = self._aot_avals(family, bucket)
+        with self._ctx():
+            compiled = self.aot_store.build(
+                family, jitted, avals, self._aot_env(family, bucket),
+                origin=self._aot_origin)
+        return SafeCompiled(compiled, jitted, self.aot_store, family)
+
+    def warm_aot(self, origin: str = "warm") -> dict:
+        """Eagerly build (load or compile+store) every program this
+        configuration can request — `enumerate_trace_signatures`
+        exactly: the plain step, the fused step (chunked) or one admit
+        per pow2 bucket (wave), the spec step and the tier promote when
+        their gates are on. After a warmed spin-up the engine serves
+        with zero JIT compiles (TraceGuard counts stay 0). Returns the
+        store's stats ({} with the store off)."""
+        if self.aot_store is None:
+            return {}
+        prev, self._aot_origin = self._aot_origin, origin
+        try:
+            self._get_step_fn()
+            if self.prefill_chunk:
+                self._get_fused_step_fn()
+            else:
+                for b in enumerate_prefill_buckets(
+                        self.min_bucket, self.block_size, self.max_len):
+                    self._get_admit_fn(b)
+            if self.spec_decode:
+                self._get_spec_step_fn()
+            if self.host_tier is not None:
+                self._get_promote_fn()
+        finally:
+            self._aot_origin = prev
+        return self.aot_store.stats()
+
+    @property
+    def aot_stats(self) -> dict:
+        return self.aot_store.stats() if self.aot_store is not None \
+            else {}
+
     def _get_step_fn(self):
         if self._step_fn is not None:
             return self._step_fn
         step = make_step_fn(self.model, self._sample,
                             on_trace=self.trace_guards["step"].mark)
-        self._step_fn = jax.jit(step, donate_argnums=self._donate)
+        self._step_fn = self._build_aot(
+            "step", jax.jit(step, donate_argnums=self._donate))
         return self._step_fn
 
     def _get_fused_step_fn(self):
@@ -727,8 +863,9 @@ class DecodeEngine:
         fused_step = make_fused_step_fn(
             self.model, self._sample, self.n_slots, self.table_width,
             on_trace=self.trace_guards["fused_step"].mark)
-        self._fused_step_fn = jax.jit(fused_step,
-                                      donate_argnums=self._donate)
+        self._fused_step_fn = self._build_aot(
+            "fused_step", jax.jit(fused_step,
+                                  donate_argnums=self._donate))
         return self._fused_step_fn
 
     def _get_spec_step_fn(self):
@@ -737,7 +874,8 @@ class DecodeEngine:
         spec = make_spec_step_fn(
             self.model, self._sample, self.spec_k,
             on_trace=self.trace_guards["spec_step"].mark)
-        self._spec_step_fn = jax.jit(spec, donate_argnums=self._donate)
+        self._spec_step_fn = self._build_aot(
+            "spec_step", jax.jit(spec, donate_argnums=self._donate))
         return self._spec_step_fn
 
     def _get_promote_fn(self):
@@ -748,7 +886,8 @@ class DecodeEngine:
         # promote donates the CACHES (arg 0, vs arg 1 in the step
         # families) so the pool recycles in place on TPU
         donate = (0,) if jax.default_backend() == "tpu" else ()
-        self._promote_fn = jax.jit(fn, donate_argnums=donate)
+        self._promote_fn = self._build_aot(
+            "promote", jax.jit(fn, donate_argnums=donate))
         return self._promote_fn
 
     def _get_admit_fn(self, bucket: int):
@@ -764,7 +903,9 @@ class DecodeEngine:
         # a fresh bucket legitimately compiles one new program; a RE-trace
         # of an existing bucket stays over budget and trips the guard
         self.trace_guards["admit"].allow()
-        fn = jax.jit(admit, donate_argnums=self._donate)
+        fn = self._build_aot("admit",
+                             jax.jit(admit, donate_argnums=self._donate),
+                             bucket=bucket)
         self._admit_fns[bucket] = fn
         return fn
 

@@ -106,6 +106,8 @@ def _classify(rec: dict) -> Optional[str]:
         return "trace"
     if "step" in rec and "step_ms" in rec:
         return "engine"
+    if "spinup" in rec and "ms" in rec:
+        return "spinup"
     return None
 
 
@@ -113,7 +115,7 @@ def discover(run_dir: str) -> dict:
     """Classify every `*.jsonl` under run_dir (one level deep — run
     dirs nest per-replica artifacts flat) by its first record's shape."""
     out: dict = {"engine": [], "trace": [], "train": [],
-                 "supervisor": [], "skipped": []}
+                 "supervisor": [], "spinup": [], "skipped": []}
     names = []
     for root, _dirs, files in os.walk(run_dir):
         for fn in files:
@@ -265,6 +267,47 @@ def _analyze_supervisor(paths: list[str]) -> Optional[dict]:
     }
 
 
+def _analyze_spinup(paths: list[str]) -> Optional[dict]:
+    """Replica spin-up phases (serve/__main__.py's spinup.jsonl, round
+    22): each record is one timed phase — the checkpoint/demo weights
+    build (`spinup: weights, phase: load`), per-program AOT store events
+    (`spinup: aot`, phase `load` = executable deserialized from the
+    store, `compile` = JIT on a store miss), and the warm-walk wall
+    (`spinup: aot_warm`). The load/compile split is the spin-up half of
+    the TTFT decomposition — analyze() joins it with the trace
+    section's queue+prefill half when both are present."""
+    recs = [r for p in paths for r in _read_jsonl(p)
+            if "spinup" in r and "ms" in r]
+    if not recs:
+        return None
+    progs = [r for r in recs if r.get("spinup") == "aot"]
+    load_ms = sum(float(r["ms"]) for r in recs
+                  if r.get("phase") == "load")
+    compile_ms = sum(float(r["ms"]) for r in recs
+                     if r.get("phase") == "compile")
+    weights = [float(r["ms"]) for r in recs
+               if r.get("spinup") == "weights"]
+    warm = [float(r["ms"]) for r in recs
+            if r.get("spinup") == "aot_warm"]
+    fams: dict[str, int] = {}
+    for r in progs:
+        fams[r.get("family", "?")] = fams.get(r.get("family", "?"), 0) + 1
+    return {
+        "files": paths,
+        "spinups": len(weights) or len(warm) or 1,
+        "load_ms": round(load_ms, 2),
+        "compile_ms": round(compile_ms, 2),
+        "weights_load_ms": dist(weights, nd=1),
+        "aot_warm_wall_ms": dist(warm, nd=1),
+        "programs": {
+            "loaded": sum(1 for r in progs if r.get("phase") == "load"),
+            "compiled": sum(1 for r in progs
+                            if r.get("phase") == "compile"),
+            "by_family": dict(sorted(fams.items())),
+        },
+    }
+
+
 # --------------------------------------------------------------- driver
 def analyze(run_dir: str) -> dict:
     """Replay one run dir into distributions + fitted models. Returns a
@@ -275,8 +318,21 @@ def analyze(run_dir: str) -> dict:
     trace = _analyze_trace(files["trace"])
     train = _analyze_train(files["train"])
     sup = _analyze_supervisor(files["supervisor"])
+    spin = _analyze_spinup(files["spinup"])
+    if spin is not None and trace is not None:
+        # the full first-token decomposition (round 22): the spin-up
+        # phases put a program in hand (weights load + AOT store reads,
+        # or a JIT compile on miss), then the first request queues and
+        # prefills — cold vs warmed replicas differ ONLY in the compile
+        # term, which a warmed store drives to zero
+        prefill = trace["phases"].get("sched.prefill", {})
+        spin["ttft_split_ms"] = {
+            "load": spin["load_ms"],
+            "compile": spin["compile_ms"],
+            "prefill": prefill.get("p50"),
+        }
     sections = {"engine": engine, "trace": trace, "train": train,
-                "supervisor": sup}
+                "supervisor": sup, "spinup": spin}
     notes = []
     max_mae = knob("OBS_REPORT_MAX_MAE_PCT")
     if engine is not None:
@@ -363,6 +419,24 @@ def _render_md(a: dict) -> str:
         if sup["recovery_s"].get("n"):
             L += ["**recovery latency (worker_down → restart/remesh, "
                   "s)**", "", _md_table(sup["recovery_s"]), ""]
+    spin = a.get("spinup")
+    if spin:
+        pg = spin["programs"]
+        L += ["## Spin-up (replica start phases)", "",
+              f"{spin['spinups']} spin-up(s); programs: "
+              f"{pg['loaded']} read from the AOT store, "
+              f"{pg['compiled']} JIT-compiled; phase totals "
+              f"`load {spin['load_ms']} ms` / "
+              f"`compile {spin['compile_ms']} ms` "
+              "(a warmed store drives the compile term to zero).", ""]
+        ts = spin.get("ttft_split_ms")
+        if ts:
+            L += ["### First-token split "
+                  "(TTFT ≈ load + compile + prefill)", "",
+                  _md_table(ts), ""]
+        for key in ("weights_load_ms", "aot_warm_wall_ms"):
+            if spin[key].get("n"):
+                L += [f"**{key}**", "", _md_table(spin[key]), ""]
     return "\n".join(L).rstrip() + "\n"
 
 
@@ -392,6 +466,13 @@ def cost_model(a: dict) -> dict:
     if sup:
         out["supervisor"] = {k: sup[k] for k in
                              ("events", "recovery_s")}
+    spin = a.get("spinup")
+    if spin:
+        out["spinup"] = {k: spin[k] for k in
+                         ("load_ms", "compile_ms", "programs",
+                          "weights_load_ms", "aot_warm_wall_ms")}
+        if "ttft_split_ms" in spin:
+            out["spinup"]["ttft_split_ms"] = spin["ttft_split_ms"]
     return out
 
 

@@ -924,6 +924,12 @@ def main(argv: Optional[list] = None) -> int:
                     help="regenerate the golden matrix file")
     ap.add_argument("--no-golden", action="store_true",
                     help="skip the golden diff")
+    ap.add_argument("--aot-store", metavar="DIR", default=None,
+                    help="cross-check an AOT program store's manifests "
+                         "against enumerate_trace_signatures (an "
+                         "uncovered signature or a stale key the engine "
+                         "can never request fails, same as a golden "
+                         "divergence)")
     args = ap.parse_args(argv)
 
     # virtual CPU devices for the traced meshes — BEFORE any backend use
@@ -940,8 +946,11 @@ def main(argv: Optional[list] = None) -> int:
         def progress(msg):
             print(f"[{time.time() - t0:6.1f}s] {msg}", file=sys.stderr)
         reports = check_matrix(trace_mode=trace_mode, progress=progress)
+    elif args.aot_store:
+        reports = []   # store-only invocation: just the cross-check
     else:
-        ap.error("one of --all / --update-golden / --cell is required")
+        ap.error("one of --all / --update-golden / --cell / "
+                 "--aot-store is required")
 
     payload = reports_payload(reports, trace_mode)
     if args.update_golden:
@@ -985,7 +994,19 @@ def main(argv: Optional[list] = None) -> int:
             print(f"report -> {args.json}")
     for d in diffs:
         print(f"golden diff: {d}", file=sys.stderr)
-    return 0 if payload["ok"] and not diffs else 1
+
+    # AOT store cross-check (ISSUE 18): the store's warm manifest set
+    # must equal the engine's static program enumeration — the same
+    # closed-form universe the trace-budget audit above validates.
+    aot_errors: list = []
+    if args.aot_store:
+        from distributed_pytorch_tpu.parallel import aot_store as aot_mod
+        aot_errors = aot_mod.crosscheck(aot_mod.AOTStore(args.aot_store))
+        for e in aot_errors:
+            print(f"aot-store diff: {e}", file=sys.stderr)
+        print(f"aot-store cross-check: "
+              f"{'DIVERGED' if aot_errors else 'ok'} ({args.aot_store})")
+    return 0 if payload["ok"] and not diffs and not aot_errors else 1
 
 
 if __name__ == "__main__":

@@ -93,6 +93,18 @@ def build_args(argv=None):
                         "(multiple of --kv-block; pick N >= slots + "
                         "kv-block). 0 = legacy all-or-nothing wave "
                         "prefill (the A/B baseline)")
+    p.add_argument("--aot-store", "--aot_store", dest="aot_store",
+                   type=str, default="",
+                   help="AOT program store dir (parallel/aot_store.py): "
+                        "spin-up loads serialized executables instead "
+                        "of JIT-compiling (misses compile + write "
+                        "back); empty defers to the AOT_STORE/"
+                        "AOT_STORE_DIR knobs")
+    p.add_argument("--aot-strict", "--aot_strict", dest="aot_strict",
+                   choices=["off", "warn", "require"], default=None,
+                   help="store-miss handling (default: the AOT_STRICT "
+                        "knob); require raises — the zero-cold-start "
+                        "CI proof")
     return p.parse_args(argv)
 
 
@@ -111,15 +123,18 @@ def _demo_model():
     return model, dict(variables), None, "single"
 
 
-async def _amain(args) -> None:
+def build_engine(args, *, warm: bool = True):
+    """Engine spin-up shared by this CLI and scripts/aot_warm.py (the
+    warming CLI MUST build through the same code path so its store keys
+    equal a serving replica's by construction). Returns (engine,
+    encoder, weights_version, spinup) where `spinup` is the phase
+    record list {phase: load|warm, ms} the TTFT-split report reads."""
+    import time
+
     from distributed_pytorch_tpu.engine import DecodeEngine
-    from distributed_pytorch_tpu.obs import trace as obs_trace
-    from distributed_pytorch_tpu.serve.scheduler import Scheduler
-    from distributed_pytorch_tpu.serve.server import ServeApp
 
-    if not args.trace:
-        obs_trace.get_recorder().enabled = False
-
+    spinup = []
+    t0 = time.perf_counter()
     if args.demo:
         model, variables, mesh, recipe = _demo_model()
         encoder = None
@@ -132,6 +147,8 @@ async def _amain(args) -> None:
          weights_version) = load_for_inference(args.ckpt, shard=args.shard)
         recipe = train_cfg.parallelism if mesh is not None else "single"
         encoder = _encoder()
+    spinup.append({"spinup": "weights", "phase": "load",
+                   "ms": round((time.perf_counter() - t0) * 1e3, 3)})
 
     # --kv-host-gb prices a host-RAM tier budget into whole KV blocks
     # with the planner's bytes-per-token model (train/memplan.py) and
@@ -148,6 +165,10 @@ async def _amain(args) -> None:
             cache_dtype_size=1 if args.cache_dtype == "int8" else 2)
         host_tier = host_blocks > 0
 
+    aot_store = None
+    if args.aot_store:
+        from distributed_pytorch_tpu.parallel.aot_store import AOTStore
+        aot_store = AOTStore(args.aot_store, strict=args.aot_strict)
     eng = DecodeEngine(model, variables, n_slots=args.slots,
                        cache_dtype=args.cache_dtype or None,
                        quantize_weights=args.quant_weights,
@@ -158,7 +179,48 @@ async def _amain(args) -> None:
                        block_size=args.kv_block, n_blocks=args.kv_blocks,
                        prefix_cache=args.prefix_cache,
                        prefill_chunk=args.prefill_chunk,
-                       host_tier=host_tier, host_blocks=host_blocks)
+                       host_tier=host_tier, host_blocks=host_blocks,
+                       aot_store=aot_store)
+    if warm and eng.aot_store is not None:
+        # eager spin-up: every program this config can request is built
+        # NOW (hit = deserialize, miss = compile + write back), so
+        # first-token latency is weight load + prefill, never compile
+        t0 = time.perf_counter()
+        stats = eng.warm_aot(origin="runtime")
+        spinup.append({"spinup": "aot_warm", "phase": "warm",
+                       "ms": round((time.perf_counter() - t0) * 1e3, 3)})
+        spinup.extend(dict(ev, spinup="aot")
+                      for ev in eng.aot_store.events)
+        print(f"aot store: {stats['hits']} hit(s), "
+              f"{stats['misses']} miss(es), "
+              f"compile {stats['compile_ms']:.0f}ms, "
+              f"load {stats['load_ms']:.0f}ms ({eng.aot_store.root})")
+    return eng, encoder, weights_version, spinup
+
+
+def _dump_spinup(spinup) -> None:
+    """Append this spin-up's phase records to runs/serve/spinup.jsonl —
+    the obs/replay 'spinup' section's source (TTFT split into
+    {load, compile, prefill})."""
+    import json
+    import os
+    path = os.path.join("runs", "serve", "spinup.jsonl")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "a") as f:
+        for rec in spinup:
+            f.write(json.dumps(rec) + "\n")
+
+
+async def _amain(args) -> None:
+    from distributed_pytorch_tpu.obs import trace as obs_trace
+    from distributed_pytorch_tpu.serve.scheduler import Scheduler
+    from distributed_pytorch_tpu.serve.server import ServeApp
+
+    if not args.trace:
+        obs_trace.get_recorder().enabled = False
+
+    eng, encoder, weights_version, spinup = build_engine(args)
+    _dump_spinup(spinup)
     sched = Scheduler(eng, max_queue=args.max_queue,
                       default_deadline_s=args.deadline_s)
     # provenance labels for /metrics scrapes and bench JSON (the engine

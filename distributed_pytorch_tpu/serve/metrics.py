@@ -342,12 +342,19 @@ class ServeMetrics:
     #: evictions saved to host RAM, promoted = radix hits staged back
     #: into HBM, dropped = lost to the host LRU cap — the only way
     #: tier-managed KV is ever lost.
+    #: 'aot_store_hits'/'aot_store_misses' mirror the AOT program
+    #: store's ledger (parallel/aot_store.py, delta-synced like the
+    #: tier counters): hit = a compiled program deserialized from disk
+    #: (no JIT), miss = a cold compile + write-back — a warmed replica
+    #: must scrape misses == 0 (the serve smoke and tier-1 CI assert
+    #: it); the router federates both across the fleet.
     COUNTERS = ("submitted", "admitted", "completed", "cancelled", "shed",
                 "failed", "tokens_out", "preempted", "requeued",
                 "prefix_hit_tokens", "prefix_miss_tokens",
                 "spec_drafted_tokens", "spec_accepted_tokens",
                 "kv_tier_demoted_blocks", "kv_tier_promoted_blocks",
-                "kv_tier_dropped_blocks")
+                "kv_tier_dropped_blocks",
+                "aot_store_hits", "aot_store_misses")
 
     def __init__(self):
         self._gauges: dict[str, tuple[Callable[[], float], str]] = {}
@@ -493,6 +500,15 @@ class ServeMetrics:
                   f"{self.counters['spec_drafted_tokens']}",
                   f'serve_spec_tokens_total{{kind="accepted"}} '
                   f"{self.counters['spec_accepted_tokens']}"]
+        lines += ["# HELP serve_aot_store_programs_total AOT program "
+                  "store ledger: executables read from the store (hit) "
+                  "vs JIT-compiled on miss (parallel/aot_store.py); a "
+                  "warmed replica must scrape miss == 0",
+                  "# TYPE serve_aot_store_programs_total counter",
+                  f'serve_aot_store_programs_total{{event="hit"}} '
+                  f"{self.counters['aot_store_hits']}",
+                  f'serve_aot_store_programs_total{{event="miss"}} '
+                  f"{self.counters['aot_store_misses']}"]
         for ev in ("demoted", "promoted", "dropped"):
             name = f"kv_tier_{ev}_blocks_total"
             lines += [f"# HELP {name} host-RAM KV tier blocks {ev} "

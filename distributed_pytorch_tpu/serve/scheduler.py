@@ -295,6 +295,23 @@ class Scheduler:
             "fraction of tier probes (after an HBM radix miss) served "
             "from host RAM")
         self._tier_seen = {"demoted": 0, "promoted": 0, "dropped": 0}
+        # AOT program store (parallel/aot_store.py): hit/miss counters
+        # delta-synced alongside the tier counters; compile/load wall
+        # time as gauges so /metrics shows what spin-up actually paid.
+        # The init-time sync publishes a pre-serve warm_aot() walk
+        # before the first request lands.
+        self.metrics.register_gauge(
+            "serve_aot_store_compile_ms",
+            lambda: (self.engine.aot_store.compile_ms
+                     if getattr(self.engine, "aot_store", None) else 0.0),
+            "wall-clock ms spent JIT-compiling on AOT store misses")
+        self.metrics.register_gauge(
+            "serve_aot_store_load_ms",
+            lambda: (self.engine.aot_store.load_ms
+                     if getattr(self.engine, "aot_store", None) else 0.0),
+            "wall-clock ms spent deserializing stored executables")
+        self._aot_seen = {"hits": 0, "misses": 0}
+        self._aot_sync()
         # provenance: the engine's serving-relevant config as a
         # Prometheus info gauge (and in the bench JSON via summary())
         self.metrics.set_build_info(**engine_build_info(engine))
@@ -638,6 +655,20 @@ class Scheduler:
         for nbytes in tier.drain_promote_events():
             self.metrics.kv_tier_promote_bytes.observe(float(nbytes))
 
+    def _aot_sync(self) -> None:
+        """Fold the AOT store's lifetime hit/miss counts into the
+        metrics registry as deltas (same contract as _tier_sync: the
+        store only mutates inside engine program builds, so reading
+        after an engine call races nothing)."""
+        store = getattr(self.engine, "aot_store", None)
+        if store is None:
+            return
+        for k, total in (("hits", store.hits), ("misses", store.misses)):
+            delta = total - self._aot_seen[k]
+            if delta:
+                self.metrics.inc(f"aot_store_{k}", delta)
+                self._aot_seen[k] = total
+
     def _finish(self, req: _Request, ret: Retired, now: float) -> None:
         self.metrics.inc("completed")
         self.metrics.retired(ret.reason)
@@ -689,6 +720,7 @@ class Scheduler:
                     break
                 await self._admit_wave(loop)
                 self._tier_sync()      # admits demote (preempt) + promote
+                self._aot_sync()       # admits can build fresh buckets
                 if not self._live:
                     if not self._queue:        # idle: park until work
                         self._wake.clear()
@@ -708,6 +740,7 @@ class Scheduler:
                                                  self.engine.step)
                 now = time.perf_counter()
                 self._tier_sync()      # steps demote via _ensure_blocks
+                self._aot_sync()       # first step builds its program
                 if getattr(self.engine, "prefill_chunk", 0):
                     # per-step chunk budget use: the chunk-size tuning
                     # signal (p50 ~ budget => prefill-bound, ~0 => slack)

@@ -393,6 +393,23 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
 
     train_step = make_train_step(model, tx, model_cfg, train_cfg, mesh,
                                  state_sharding)
+    # AOT program store (parallel/aot_store.py, ISSUE 18): with the
+    # AOT_STORE knobs on, the train step is resolved through the store —
+    # a hit hands the loop a deserialized executable (restart-to-first-
+    # step is then dominated by the checkpoint restore above, not XLA),
+    # a miss compiles eagerly and writes back for the next incarnation.
+    # The supervisor pre-warms the rung-down key set on re-mesh, so a
+    # surviving gang's restart hits.
+    from distributed_pytorch_tpu.parallel import aot_store as aot_mod
+    _store = aot_mod.resolve_store()
+    if _store is not None:
+        train_step = aot_mod.wrap_train_step(
+            _store, train_step, state, model_cfg, train_cfg, mesh,
+            grad_accum=grad_accum, b_glob=b_glob)
+        say(f"aot store: train_step "
+            f"{'hit' if _store.hits else 'miss'} "
+            f"(hits={_store.hits} misses={_store.misses} "
+            f"compile_ms={_store.compile_ms:.0f} root={_store.root})")
     eval_step = make_eval_step(model, train_cfg, mesh, state_sharding)
 
     # ---- loop ------------------------------------------------------------

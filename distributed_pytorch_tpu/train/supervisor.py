@@ -190,6 +190,7 @@ class SupervisorConfig:
     hb_interval_s: float = 0.5
     metrics_port: int = -1         # opt-in TelemetryServer: -1 off,
     #                                0 ephemeral, >0 fixed
+    prewarm_timeout_s: float = 300.0  # AOT re-mesh pre-warm budget
 
 
 @dataclasses.dataclass
@@ -214,9 +215,17 @@ class Supervisor:
     def __init__(self, cfg: SupervisorConfig,
                  worker_cmd: Optional[
                      Callable[[int, int, bool], list[str]]] = None,
-                 log: Callable[[str], None] = print):
+                 log: Callable[[str], None] = print,
+                 prewarm_cmd: Optional[
+                     Callable[[int], Optional[list[str]]]] = None):
         self.cfg = cfg
         self.worker_cmd = worker_cmd or self._default_worker_cmd
+        # `prewarm_cmd(new_n)` -> argv (or None = skip) runs SYNCHRONOUSLY
+        # between a rung-down re-mesh decision and the gang restart,
+        # compiling the new topology's train-step key set into the AOT
+        # store (parallel/aot_store.py) so the restarted workers' first
+        # step is a store hit. Tests inject a stub, same as worker_cmd.
+        self.prewarm_cmd = prewarm_cmd or self._default_prewarm_cmd
         self.log = log
         self.run_dir = os.path.join("runs", cfg.run_name)
         self.ckpt_root = os.path.join("checkpoints", cfg.run_name)
@@ -255,6 +264,46 @@ class Supervisor:
         return [sys.executable, "-m",
                 "distributed_pytorch_tpu.train.supervisor",
                 "--worker", "--", *argv]
+
+    def _default_prewarm_cmd(self, n: int) -> Optional[list[str]]:
+        """The aot_store CLI over this run's train argv, gated on the
+        store knobs (the gate mirrors aot_store.resolve_store — the
+        knob read keeps this module jax-free; a disabled store costs no
+        subprocess). The CLI itself skips n > 1: multi-process program
+        keys are not reproducible in one process by design."""
+        mode = cfg_mod.knob("AOT_STORE")
+        if mode == "off" or (mode == "auto"
+                             and not cfg_mod.knob("AOT_STORE_DIR")):
+            return None
+        cmd = [sys.executable, "-m",
+               "distributed_pytorch_tpu.parallel.aot_store",
+               "--warm-train", "--hosts", str(n)]
+        if self.cfg.cpu_devices > 0:
+            cmd += ["--cpu-devices", str(self.cfg.cpu_devices)]
+        return cmd + ["--", *self.cfg.train_argv]
+
+    def _prewarm(self, n: int) -> None:
+        """Run the pre-warm subprocess for the new topology and record
+        the outcome on the timeline; failures never block the restart —
+        the workers just JIT (the pre-store behavior)."""
+        cmd = self.prewarm_cmd(n)
+        if not cmd:
+            return
+        t0 = time.monotonic()
+        log_path = os.path.join(self.run_dir,
+                                f"prewarm.gen{self.generation + 1}.log")
+        try:
+            with open(log_path, "w") as logf:
+                rc = subprocess.run(
+                    cmd, stdout=logf, stderr=subprocess.STDOUT,
+                    timeout=self.cfg.prewarm_timeout_s).returncode
+        except (subprocess.TimeoutExpired, OSError) as e:
+            self._event("aot_prewarm", n_hosts=n, rc=-1,
+                        error=type(e).__name__,
+                        ms=round((time.monotonic() - t0) * 1e3, 1))
+            return
+        self._event("aot_prewarm", n_hosts=n, rc=rc,
+                    ms=round((time.monotonic() - t0) * 1e3, 1))
 
     def _last_verified_step_num(self) -> float:
         path = _latest_verified_step(self.ckpt_root)
@@ -473,6 +522,11 @@ class Supervisor:
                         pass
                 self.n_hosts = new_n
                 self.restarts = 0  # fresh topology, fresh budget
+                # pre-warm the rung-down key set BEFORE spawning: the
+                # restarted gang's first step then loads its compiled
+                # program instead of paying a full XLA compile on top of
+                # the re-mesh outage (parallel/aot_store.py, ISSUE 18)
+                self._prewarm(new_n)
             else:
                 self.restarts += 1
                 if self.restarts > self.cfg.max_restarts:
@@ -543,6 +597,10 @@ def cli(argv: Optional[Sequence[str]] = None) -> int:
                    help="opt-in telemetry HTTP port (gang state, event "
                         "counters, heartbeat ages, last verified ckpt "
                         "step); -1 off, 0 ephemeral")
+    p.add_argument("--prewarm-timeout-s", type=float, default=300.0,
+                   help="wall-clock budget for the AOT re-mesh pre-warm "
+                        "subprocess (parallel/aot_store.py; no-op with "
+                        "the AOT_STORE knobs off)")
     args = p.parse_args(sup_argv)
 
     cfg = SupervisorConfig(
@@ -559,6 +617,7 @@ def cli(argv: Optional[Sequence[str]] = None) -> int:
         remesh_deadline_s=args.remesh_deadline_s,
         cpu_devices=args.cpu_devices,
         metrics_port=args.metrics_port,
+        prewarm_timeout_s=args.prewarm_timeout_s,
     )
     return Supervisor(cfg).run()
 

@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Build the AOT program store ahead of time (ISSUE 18).
+
+Walks a matrix of serving/training configurations and compiles every
+program each one can request — the engine legs go through
+`serve.__main__.build_engine` (the SAME spin-up path a replica runs, so
+the produced keys equal a replica's by construction) and `warm_aot()`
+(which walks `enumerate_trace_signatures` + the prefill buckets); the
+train legs go through `aot_store.warm_train` (mirroring the loop
+preamble). Ends with the manifest cross-check: a signature the store
+doesn't cover, or a stale key no engine can request, exits 1.
+
+Intended uses: image build time (bake the store next to the weights so
+replica add-to-first-token is weight load, not compile), and the tier-1
+CI job that proves a warmed serve smoke runs with aot_store_misses == 0.
+
+    python scripts/aot_warm.py --store runs/aot_store
+    python scripts/aot_warm.py --store S \
+        --serve-leg "--demo --slots 2 --temperature 0.0" \
+        --train-leg "--dataset synthetic --max_iters 2 ..."
+"""
+
+import argparse
+import json
+import os
+import shlex
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# The default "demo matrix": the serve smoke's exact engine configs
+# (scripts/serve_smoke.sh: --slots 2 --temperature 0.0, wave + chunked)
+# and the fault-injection harness's tiny train config — everything the
+# CI smokes can spin up warmed.
+DEMO_SERVE_LEGS = (
+    ("serve/demo/wave", "--demo --slots 2 --temperature 0.0"),
+    ("serve/demo/chunked",
+     "--demo --slots 2 --temperature 0.0 --prefill-chunk 32"),
+)
+DEMO_TRAIN_LEGS = (
+    ("train/demo/single",
+     "--dataset synthetic --platform cpu --parallelism single "
+     "--file_name aot_demo --seed 7 --max_iters 2 --log_interval 1 "
+     "--total_batch_size_str 64 --batch_size 1 --vocab_size 256 "
+     "--block_size 32 --n_embd 32 --n_head 4 --n_kv_heads 2 "
+     "--n_layer 2 --up_dim 48"),
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Pre-build the AOT program store for a matrix of "
+                    "serve/train configs, then cross-check the "
+                    "manifests against the static program enumeration")
+    ap.add_argument("--store", required=True, help="store directory")
+    ap.add_argument("--serve-leg", action="append", default=[],
+                    metavar="ARGS", help="serve CLI args for one engine "
+                    "config (repeatable; replaces the demo matrix)")
+    ap.add_argument("--train-leg", action="append", default=[],
+                    metavar="ARGS", help="train CLI args for one train "
+                    "config (repeatable; replaces the demo matrix)")
+    ap.add_argument("--skip-train", action="store_true",
+                    help="serve legs only")
+    ap.add_argument("--no-crosscheck", action="store_true")
+    ap.add_argument("--json", metavar="PATH", default=None,
+                    help="write the warm report ('-'=stdout)")
+    args = ap.parse_args(argv)
+
+    from distributed_pytorch_tpu.parallel import aot_store as aot_mod
+    from distributed_pytorch_tpu.serve.__main__ import (build_args,
+                                                        build_engine)
+
+    serve_legs = ([("serve/cli", leg) for leg in args.serve_leg]
+                  or list(DEMO_SERVE_LEGS))
+    train_legs = ([("train/cli", leg) for leg in args.train_leg]
+                  or list(DEMO_TRAIN_LEGS))
+    if args.skip_train:
+        train_legs = []
+
+    store = aot_mod.AOTStore(args.store)
+    report = {"store": args.store, "legs": []}
+    for name, leg in serve_legs:
+        t0 = time.perf_counter()
+        sargs = build_args(shlex.split(leg) + ["--aot-store", args.store])
+        eng, _, _, _ = build_engine(sargs, warm=False)
+        # swap in the shared store so one ledger covers the whole matrix
+        eng.aot_store = store
+        before = (store.hits, store.misses)
+        eng.warm_aot(origin="warm")
+        report["legs"].append({
+            "leg": name, "args": leg,
+            "hits": store.hits - before[0],
+            "misses": store.misses - before[1],
+            "s": round(time.perf_counter() - t0, 2)})
+        print(f"[aot_warm] {name}: +{store.misses - before[1]} compiled, "
+              f"{store.hits - before[0]} already stored "
+              f"({report['legs'][-1]['s']}s)")
+    for name, leg in train_legs:
+        t0 = time.perf_counter()
+        before = (store.hits, store.misses)
+        aot_mod.warm_train(store, shlex.split(leg))
+        report["legs"].append({
+            "leg": name, "args": leg,
+            "hits": store.hits - before[0],
+            "misses": store.misses - before[1],
+            "s": round(time.perf_counter() - t0, 2)})
+        print(f"[aot_warm] {name}: +{store.misses - before[1]} compiled, "
+              f"{store.hits - before[0]} already stored "
+              f"({report['legs'][-1]['s']}s)")
+
+    report["stats"] = store.stats()
+    errors = [] if args.no_crosscheck else aot_mod.crosscheck(store)
+    report["crosscheck_errors"] = errors
+    for e in errors:
+        print(f"[aot_warm] crosscheck: {e}", file=sys.stderr)
+    print(f"[aot_warm] {report['stats']['entries']} entr(ies), "
+          f"{len(errors)} crosscheck error(s), "
+          f"compile {report['stats']['compile_ms']:.0f}ms")
+    if args.json == "-":
+        print(json.dumps(report, indent=1, sort_keys=True))
+    elif args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=1, sort_keys=True)
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,7 +14,12 @@
 #                            arrivals, and the round-21 serve_load_tier
 #                            leg: host-RAM KV tier on/off with the HBM
 #                            pool clamped to 0.1x working set, same
-#                            seeded arrivals; worst case ~75 min if the tunnel
+#                            seeded arrivals, and the round-22
+#                            serve_spinup leg: replica start->first-token
+#                            cold vs warmed from the AOT program store
+#                            plus the train restart sub-leg
+#                            (warm-faster / hit-rate-1 / greedy-parity
+#                            accept booleans); worst case ~75 min if the tunnel
 #                            goes half-up mid-bench, so the cap is 90 min —
 #                            bench always prints its JSON line if allowed
 #                            to finish)

@@ -5,10 +5,12 @@
 
 Loads every `*.jsonl` under the run dir (engine `timeline.jsonl`,
 request `trace.jsonl`, `train_timeline.jsonl`,
-`supervisor_timeline.jsonl` — classified by record shape, so
-fault-inject log dirs with per-replica timelines work too), computes
-per-phase distributions, fits the PERF.md latency models, and writes
-`report.md` + `cost_model.json` next to the inputs (or into --out).
+`supervisor_timeline.jsonl`, replica-spin-up `spinup.jsonl` —
+classified by record shape, so fault-inject log dirs with per-replica
+timelines work too), computes per-phase distributions, fits the
+PERF.md latency models (incl. the round-22 first-token split
+TTFT ≈ load + compile + prefill), and writes `report.md` +
+`cost_model.json` next to the inputs (or into --out).
 
 Exit status: 0 on a usable report, 2 when the run dir is degenerate
 (no timeline records at all — the CI gate for an empty smoke leg), 1
@@ -48,7 +50,8 @@ def main(argv=None) -> int:
     else:
         print(f"report:     {a['report_md']}")
         print(f"cost model: {a['cost_model_json']}")
-        for kind in ("engine", "trace", "train", "supervisor"):
+        for kind in ("engine", "trace", "train", "supervisor",
+                     "spinup"):
             n = len(a["files"][kind])
             if n:
                 print(f"  {kind}: {n} file(s)")

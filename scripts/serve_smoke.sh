@@ -57,6 +57,32 @@ SPEC_DECODE=on SPEC_K=4 \
   METRICS_GREP='serve_spec_tokens_total|serve_spec_accepted_token_rate' \
   smoke_one
 
+# AOT program-store leg (round 22): warm the store with scripts/aot_warm
+# (the exact wave + chunked demo configs above), then serve each config
+# out of it. The warmed server must read EVERY program from the store —
+# the grep surfaces the hit/miss ledger, and the assert below pins
+# misses == 0 and hits > 0 on both warmed runs (the zero-cold-start
+# replica spin-up contract, on the same HTTP smoke path as the cold
+# legs above). Demo-only: the matrix aot_warm bakes is the demo one.
+if [ "${SRC_ARGS[0]}" = "--demo" ]; then
+  echo "=== AOT program-store smoke (warmed spin-up) ==="
+  AOT_DIR="$(mktemp -d)"
+  trap 'rm -rf "$AOT_DIR"' EXIT
+  python scripts/aot_warm.py --store "$AOT_DIR" --skip-train
+  aot_leg() {  # $@ = extra server args; asserts hits>0, misses==0
+    METRICS_GREP='aot_store' smoke_one --aot-store "$AOT_DIR" "$@" \
+      | tee /tmp/aot_smoke_$$.txt
+    grep -qE 'aot_store_programs_total\{event="hit"\} [1-9]' \
+      /tmp/aot_smoke_$$.txt
+    grep -qE 'aot_store_programs_total\{event="miss"\} 0$' \
+      /tmp/aot_smoke_$$.txt
+  }
+  aot_leg
+  aot_leg --prefill-chunk 32
+  rm -rf "$AOT_DIR" /tmp/aot_smoke_$$.txt
+  trap - EXIT
+fi
+
 # Router tier: 2 real replica processes behind the health-gated router,
 # one SIGKILLed mid-Poisson-drive and replaced on the same port. The
 # harness exits nonzero unless every request completed its full budget

@@ -570,7 +570,8 @@ def test_decode_signatures_within_budget_both_modes():
     assert ws["spec_step"] == 1             # round-20 verify program
     cs = chunked.signatures["enumerated"]
     assert cs == {"step": 1, "fused_step": 1, "admit": 0, "spec_step": 1,
-                  "buckets": []}
+                  "promote": 1, "buckets": []}  # round-22: promote is
+    # part of the static universe so the AOT store's warm walk covers it
 
 
 def test_mutation_bucketing_bug_fails_signature_enumeration(monkeypatch):

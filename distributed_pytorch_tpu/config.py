@@ -227,6 +227,19 @@ register_knob("COMMSCHECK_DEVICES", "8", int,
               "touching a backend (compat.request_cpu_devices); the "
               "default fits the 4x2 matrix meshes")
 
+# --- pipeline schedule + optimizer offload (ISSUE 19) ---
+register_knob("PP_SCHEDULE", "", lambda s: s.strip().lower(),
+              "pipeline schedule override: carry | 1f1b | auto; empty "
+              "defers to LLMConfig.pp_schedule (models/pipeline.py)")
+register_knob("PP_VPP", "0", lambda s: int(s) if s.strip() else 0,
+              "virtual chunks per pipeline stage for the 1f1b schedule; "
+              "0 defers to LLMConfig.pp_vpp (0 = auto: n_layer/pp_stages, "
+              "i.e. one-layer chunks, the maximally interleaved schedule)")
+register_knob("OFFLOAD", "", lambda s: _onoff(s) if s.strip() else "",
+              "ZeRO-Offload gate override: on | off | auto; empty defers "
+              "to TrainConfig.offload (train/offload.py — AdamW moments "
+              "in host RAM, update computed on host)")
+
 # --- AOT program store (parallel/aot_store.py, ISSUE 18) ---
 register_knob("AOT_STORE", "auto",
               lambda s: _onoff(s) if s.strip() else "auto",
@@ -328,10 +341,19 @@ class LLMConfig:
     # pipeline parallelism (models/pipeline.py; the last member of the
     # reference's "5D parallelism" goal, README.md:7). pp_stages > 1 stacks
     # the transformer blocks on a leading layer axis (sharded over the
-    # 'pipe' mesh axis) and streams pp_microbatches batch slices through an
-    # interleaved per-layer schedule. 0 microbatches = auto (2 * stages).
+    # 'pipe' mesh axis) and streams pp_microbatches batch slices through a
+    # pipeline schedule. 0 microbatches = auto (2 * stages).
+    # pp_schedule picks that schedule: 'carry' is the per-layer carry
+    # (all L layers every tick on an (L, ...) buffer); '1f1b' is the
+    # interleaved-1F1B schedule (each stage holds pp_vpp virtual chunks,
+    # bubble ~ (S-1)/(vpp*M)); 'auto' = 1f1b for dense models, carry for
+    # MoE (whose per-tick load-stats masking only the carry path carries).
+    # pp_vpp: virtual chunks per stage for 1f1b; 0 = auto (n_layer /
+    # pp_stages — one-layer chunks, the carry schedule's granularity).
     pp_stages: int = 1
     pp_microbatches: int = 0
+    pp_schedule: str = "auto"  # 'auto' | 'carry' | '1f1b'
+    pp_vpp: int = 0
 
     def __post_init__(self):
         # Cross-field normalization, mirroring reference
@@ -378,6 +400,13 @@ class LLMConfig:
             assert self.n_layer % self.pp_stages == 0, (
                 f"pp_stages {self.pp_stages} must divide n_layer "
                 f"{self.n_layer}")
+        assert self.pp_schedule in ("auto", "carry", "1f1b"), \
+            f"unknown pp_schedule {self.pp_schedule!r}"
+        assert self.pp_vpp >= 0, "pp_vpp must be >= 0 (0 = auto)"
+        if self.pp_vpp > 0 and self.pp_stages > 1:
+            assert self.n_layer % (self.pp_stages * self.pp_vpp) == 0, (
+                f"pp_stages*pp_vpp {self.pp_stages * self.pp_vpp} must "
+                f"divide n_layer {self.n_layer}")
 
     @property
     def head_size(self) -> int:
@@ -441,6 +470,15 @@ def gpt2_1p5b(**overrides) -> "LLMConfig":
     return _gpt2_preset(1600, 48, 25, 4224, **overrides)
 
 
+def gpt2_7b(**overrides) -> "LLMConfig":
+    """~6.7B Llama-7B-class rung (up_dim 10880 ~= 8*4096/3 rounded to a
+    lane multiple; 32 heads of 128). The pod-scale exit-bar rung
+    (ROADMAP): pp x fsdp x tp recipes with the interleaved-1F1B schedule
+    and ZeRO-Offload — moments in host RAM — are what make it price under
+    v5e 16 GiB/chip (train/memplan.py --offload prints the delta)."""
+    return _gpt2_preset(4096, 32, 32, 10880, **overrides)
+
+
 # name -> factory; the CLI's --preset flag and bench.py's ladder legs both
 # resolve through this table so a rung cannot drift between them.
 PRESETS = {
@@ -448,6 +486,7 @@ PRESETS = {
     "gpt2_350m": gpt2_350m,
     "gpt2_774m": gpt2_774m,
     "gpt2_1p5b": gpt2_1p5b,
+    "gpt2_7b": gpt2_7b,
 }
 
 
@@ -525,6 +564,13 @@ class TrainConfig:
     anomaly: str = "warn"            # loss/grad guard: 'skip' withholds
                                      # the optimizer update on a NaN/inf
                                      # step, 'warn' records only, 'off'
+    # ZeRO-Offload (train/offload.py, ISSUE 19): optimizer moments pinned
+    # in host RAM, the update computed on host, parameters streamed back —
+    # HBM pays params+grads+activations only, the optimizer costs PCIe
+    # bandwidth. 'auto' = on iff memplan prices the in-HBM plan over
+    # budget AND the offload plan under it; the OFFLOAD env knob
+    # overrides this field (bench/sweep A/B legs).
+    offload: str = "auto"            # auto | on | off
 
     def __post_init__(self):
         assert self.parallelism in PARALLELISM_RECIPES, \
@@ -542,6 +588,8 @@ class TrainConfig:
             f"unknown optimizer {self.optimizer!r}"
         assert self.anomaly in ("skip", "warn", "off"), \
             f"unknown anomaly mode {self.anomaly!r}"
+        assert self.offload in ("auto", "on", "off"), \
+            f"unknown offload mode {self.offload!r}"
 
 
 # ---------------------------------------------------------------------------

@@ -1,8 +1,30 @@
-"""Pipeline parallelism: interleaved per-layer schedule, GSPMD-native.
+"""Pipeline parallelism: interleaved-1F1B + per-layer carry schedules,
+GSPMD-native.
 
 The last member of the reference's "5D parallelism" goal
-(/root/reference/README.md:7) — it has no code there. TPU-first design
-instead of torch-style stage processes + P2P sends:
+(/root/reference/README.md:7) — it has no code there. Two schedules share
+one stacked-parameter layout (so checkpoints, sharding specs and the
+stack/unstack converters are schedule-agnostic):
+
+* '1f1b' (default for dense models): the interleaved-1F1B schedule
+  (Megatron-LM, PAPERS.md). Each of the S stages holds `vpp` virtual
+  chunks of Lc = L/(S*vpp) layers; chunk q (layers [q*Lc, (q+1)*Lc))
+  belongs to stage q mod S, and microbatch m = g*S + j runs chunk q at
+  tick g*S*vpp + j + q. The activation buffer is (S, b, T, C) — one slot
+  per STAGE, not per layer — rotated one slot per tick (jnp.roll, an ICI
+  collective-permute under a live 'pipe' axis; the wrap row S-1 -> 0 IS
+  the chunk hand-back of the interleaved schedule). The backward is
+  autodiff's exact reverse of the forward scan — the mirrored 1F1B
+  cooldown — so per optimizer step the timeline is warmup, fwd/bwd
+  steady state, cooldown with bubble fraction (S-1)/(S-1 + vpp*M)
+  ~ (S-1)/(vpp*M): `vpp*M` work slots against the carry schedule's
+  all-L-layers-every-tick buffer. See schedule_timeline() for the
+  per-(tick, stage) phase rows train/telemetry.py records.
+* 'carry': the round-5 per-layer carry schedule below — still the MoE
+  path (its per-tick validity masking keeps the router load statistics
+  exact) and the fallback when L % (S*vpp) != 0.
+
+TPU-first design instead of torch-style stage processes + P2P sends:
 
 * The transformer blocks are STACKED on a leading layer axis (`nn.vmap`
   over `Block` with `variable_axes={'params': 0}`), so "which stage owns
@@ -33,12 +55,15 @@ pp_stages=1 to sample; see train/checkpoint.py).
 
 from __future__ import annotations
 
+import dataclasses
+
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from distributed_pytorch_tpu.config import LLMConfig
+from distributed_pytorch_tpu.config import LLMConfig, knob
 
 
 def _pipe_constraint(t: jnp.ndarray) -> jnp.ndarray:
@@ -61,6 +86,209 @@ def _pipe_constraint(t: jnp.ndarray) -> jnp.ndarray:
     if all(a is None for a in axes):
         return t
     return jax.lax.with_sharding_constraint(t, NamedSharding(mesh, P(*axes)))
+
+
+def resolve_vpp(cfg: LLMConfig) -> int:
+    """Virtual chunks per stage for the 1f1b schedule: the PP_VPP knob,
+    else cfg.pp_vpp, else auto = n_layer/pp_stages (one-layer chunks — the
+    carry schedule's interleave granularity, minimal bubble)."""
+    vpp = knob("PP_VPP") or cfg.pp_vpp
+    if vpp <= 0:
+        vpp = max(1, cfg.n_layer // max(cfg.pp_stages, 1))
+    return vpp
+
+
+def resolve_schedule(cfg: LLMConfig) -> str:
+    """'1f1b' | 'carry' for this config. Resolution order: PP_SCHEDULE
+    knob > cfg.pp_schedule > 'auto'. Auto picks 1f1b whenever it is
+    admissible (dense model, chunk count divides the layer count) and
+    falls back to carry otherwise; asking for 1f1b explicitly when it is
+    not admissible fails loudly instead of silently degrading."""
+    choice = knob("PP_SCHEDULE") or cfg.pp_schedule
+    if choice not in ("auto", "carry", "1f1b"):
+        raise ValueError(f"unknown pp schedule {choice!r} "
+                         "(expected auto|carry|1f1b)")
+    vpp = resolve_vpp(cfg)
+    admissible = (not cfg.moe
+                  and cfg.n_layer % (max(cfg.pp_stages, 1) * vpp) == 0)
+    if choice == "1f1b" and not admissible:
+        raise ValueError(
+            f"pp_schedule=1f1b needs a dense model with pp_stages*vpp "
+            f"({cfg.pp_stages}*{vpp}) dividing n_layer ({cfg.n_layer}); "
+            f"MoE models use the carry schedule (its per-tick validity "
+            f"masking keeps the router load statistics exact)")
+    if choice == "auto":
+        return "1f1b" if admissible else "carry"
+    return choice
+
+
+@dataclasses.dataclass(frozen=True)
+class _Schedule:
+    """Static interleaved-1F1B tick table (pure numpy — computed once per
+    trace, baked into the program as scan xs)."""
+
+    n_stages: int
+    vpp: int
+    n_microbatches: int
+    ticks: int
+    q_idx: np.ndarray       # (ticks, S) chunk each stage computes (a
+                            # stage-owned dummy chunk on idle ticks)
+    valid: np.ndarray       # (ticks, S) bool: real microbatch in flight
+    mb_idx: np.ndarray      # (ticks, S) microbatch per stage (-1 = idle)
+    inject: np.ndarray      # (ticks,) 1 when a microbatch enters stage 0
+    inject_src: np.ndarray  # (ticks,) which microbatch (0 on no-op ticks)
+    exit_ticks: np.ndarray  # (M,) tick whose stage-(S-1) output finishes m
+
+
+def _build_1f1b_schedule(S: int, vpp: int, M: int) -> _Schedule:
+    """Interleaved-1F1B placement: chunk q (of S*vpp) belongs to stage
+    q mod S; microbatch m = g*S + j computes chunk q at tick
+    g*S*vpp + j + q. Per (stage, tick) the decomposition
+    u = t - s = S*(g*vpp + v) + j is unique, so a stage computes at most
+    one (chunk, microbatch) per tick, every chunk's input is exactly the
+    previous tick's roll-neighbour output (or the injected embedding for
+    chunk 0), and the schedule is valid for ANY M (not only S | M)."""
+    n_chunks = S * vpp
+    g_last, j_last = (M - 1) // S, (M - 1) % S
+    ticks = g_last * n_chunks + j_last + n_chunks
+    q_idx = np.zeros((ticks, S), np.int32)
+    valid = np.zeros((ticks, S), bool)
+    mb_idx = np.full((ticks, S), -1, np.int32)
+    inject = np.zeros((ticks,), np.int32)
+    inject_src = np.zeros((ticks,), np.int32)
+    exit_ticks = np.zeros((M,), np.int32)
+    for t in range(ticks):
+        for s in range(S):
+            u = t - s
+            if u < 0:
+                q_idx[t, s] = s  # idle: compute the stage's own chunk 0
+                continue
+            j, r = u % S, u // S
+            v, g = r % vpp, r // vpp
+            m = g * S + j
+            q = v * S + s
+            q_idx[t, s] = q
+            if m < M:
+                valid[t, s] = True
+                mb_idx[t, s] = m
+                if q == 0:
+                    inject[t] = 1
+                    inject_src[t] = m
+                if q == n_chunks - 1:
+                    exit_ticks[m] = t
+    return _Schedule(S, vpp, M, ticks, q_idx, valid, mb_idx, inject,
+                     inject_src, exit_ticks)
+
+
+def schedule_timeline(n_stages: int, vpp: int, n_microbatches: int
+                      ) -> tuple[list, dict]:
+    """Per-(tick, stage) phase rows of one 1f1b optimizer step + a bubble
+    summary — the payload train/loop.py hands train/telemetry.py and the
+    CPU A/B test checks against the (S-1)/(vpp*M) model.
+
+    The forward half comes straight from the static schedule table; the
+    backward half is its exact mirror (autodiff reverses the forward
+    scan tick-for-tick — reverse-mode through `jnp.roll` is a roll the
+    other way, so the cooldown is the mirrored warmup). Rows:
+    {tick, stage, chunk, microbatch, phase('fwd'|'bwd')}. Summary:
+    {ticks, busy_per_stage, bubble_frac, bubble_model} where
+    bubble_frac = 1 - busy/ticks (measured on the table) and
+    bubble_model = (S-1)/(vpp*M) (the Megatron interleaved-1F1B model —
+    the denominators differ by the warmup slots, which is why the test
+    bar is 20%, not equality)."""
+    sched = _build_1f1b_schedule(n_stages, vpp, n_microbatches)
+    fwd = [{"tick": int(t), "stage": int(s),
+            "chunk": int(sched.q_idx[t, s]),
+            "microbatch": int(sched.mb_idx[t, s]), "phase": "fwd"}
+           for t in range(sched.ticks) for s in range(n_stages)
+           if sched.valid[t, s]]
+    total = 2 * sched.ticks
+    bwd = [{**row, "tick": total - 1 - row["tick"], "phase": "bwd"}
+           for row in fwd]
+    rows = sorted(fwd + bwd, key=lambda r: (r["tick"], r["stage"]))
+    busy = 2 * n_microbatches * vpp
+    summary = {
+        "schedule": "1f1b", "n_stages": n_stages, "vpp": vpp,
+        "n_microbatches": n_microbatches, "ticks": total,
+        "busy_per_stage": busy,
+        "bubble_frac": round(1.0 - busy / total, 6),
+        "bubble_model": round((n_stages - 1)
+                              / max(vpp * n_microbatches, 1), 6),
+    }
+    return rows, summary
+
+
+def _run_1f1b(parent: nn.Module, cfg: LLMConfig, attn_impl: str,
+              deterministic: bool, x: jnp.ndarray, freqs,
+              M: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The interleaved-1F1B apply path (see module docstring for the
+    schedule math). Parameters stay in the carry schedule's stacked
+    layout — params['blocks']['stack'] with a leading (L,) axis — read
+    directly from the bound scope and regrouped (S*vpp, Lc, ...)
+    chunk-major, so checkpoints and sharding specs are schedule-agnostic;
+    each tick gathers the (S,)-vector of active chunks (a dynamic-slice
+    per stage under GSPMD). The (S, b, T, C) activation buffer rolls one
+    stage per tick; the wrap row IS the interleave hand-back. Backward is
+    autodiff through the forward scan — the mirrored 1F1B cooldown."""
+    from distributed_pytorch_tpu.models.gpt import Block
+    B, T, C = x.shape
+    S, L = cfg.pp_stages, cfg.n_layer
+    vpp = resolve_vpp(cfg)
+    n_chunks = S * vpp
+    Lc = L // n_chunks
+    b = B // M
+    sched = _build_1f1b_schedule(S, vpp, M)
+
+    stacked = parent.variables["params"]["blocks"]["stack"]
+    chunks = jax.tree_util.tree_map(
+        lambda leaf: leaf.reshape((n_chunks, Lc) + leaf.shape[1:]), stacked)
+    mb = x.reshape(M, b, T, C)
+
+    remat_attn = cfg.act_recomp and cfg.act_recomp_policy == "attn"
+    block = Block(cfg, attn_impl, deterministic, remat_attn)
+    need_rng = (not deterministic) and cfg.dropout > 0
+
+    def apply_layer(p, h, key):
+        rngs = {"dropout": key} if need_rng else None
+        out, _, _ = block.apply({"params": p}, h, freqs, rngs=rngs)
+        return out
+
+    if cfg.act_recomp and cfg.act_recomp_policy == "block":
+        apply_layer = jax.checkpoint(apply_layer, prevent_cse=False)
+
+    if need_rng:
+        tick_keys = jax.random.split(parent.make_rng("dropout"),
+                                     sched.ticks)
+    else:
+        tick_keys = jnp.zeros((sched.ticks, 2), jnp.uint32)
+    stage_ids = jnp.arange(S, dtype=jnp.int32)
+
+    def tick_fn(buf, xs):
+        q_t, inj, src, key = xs
+        incoming = jnp.take(mb, src, axis=0)
+        buf = buf.at[0].set(jnp.where(inj > 0, incoming, buf[0]))
+        h = _pipe_constraint(buf)
+        params_t = jax.tree_util.tree_map(
+            lambda c: jnp.take(c, q_t, axis=0), chunks)  # (S, Lc, ...)
+        for layer in range(Lc):
+            p_l = jax.tree_util.tree_map(
+                lambda c, layer=layer: c[:, layer], params_t)
+            if need_rng:  # one dropout stream per (tick, stage, layer)
+                keys_s = jax.vmap(
+                    lambda i, layer=layer: jax.random.fold_in(
+                        jax.random.fold_in(key, i), layer))(stage_ids)
+            else:
+                keys_s = jnp.zeros((S,), jnp.uint32)
+            h = jax.vmap(apply_layer)(p_l, h, keys_s)
+        h = _pipe_constraint(h)
+        return jnp.roll(h, 1, axis=0), h[-1]
+
+    buf0 = _pipe_constraint(jnp.zeros((S, b, T, C), x.dtype))
+    xs = (jnp.asarray(sched.q_idx), jnp.asarray(sched.inject),
+          jnp.asarray(sched.inject_src), tick_keys)
+    _, outs = jax.lax.scan(tick_fn, buf0, xs)
+    final = jnp.take(outs, jnp.asarray(sched.exit_ticks), axis=0)
+    return final.reshape(B, T, C), jnp.float32(0.0)
 
 
 class _PipeTick(nn.Module):
@@ -146,6 +374,12 @@ def run_pipeline(parent: nn.Module, cfg: LLMConfig, attn_impl: str,
             M -= 1
     assert B % M == 0, (
         f"pp_microbatches {M} must divide batch size {B}")
+    # Init ALWAYS runs the carry path: nn.scan(nn.vmap(Block)) creates the
+    # stacked params['blocks']['stack'] tree, and keeping that the single
+    # creator makes the param layout (and so checkpoints/sharding specs)
+    # schedule-invariant. The 1f1b apply path reads the same tree back.
+    if not parent.is_initializing() and resolve_schedule(cfg) == "1f1b":
+        return _run_1f1b(parent, cfg, attn_impl, deterministic, x, freqs, M)
     b = B // M
     ticks = M + L - 1
 

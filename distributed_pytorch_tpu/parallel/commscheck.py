@@ -406,9 +406,32 @@ def derived_train_comms(cfg: LLMConfig, recipe: str, sizes: dict,
                         "axis": "expert",
                         "bytes": 2 * cfg.n_layer * accum * tok_bytes})
     if pipe > 1:
-        entries.append({"origin": "pipe-boundary", "family": "ppermute",
-                        "axis": "pipe",
-                        "bytes": 2 * (pipe - 1) * accum * tok_bytes})
+        # schedule-aware (ISSUE 19): the carry schedule crosses each of
+        # the pipe-1 stage boundaries once per direction with the full
+        # local batch; interleaved-1F1B instead rolls the (S, b, T, C)
+        # buffer once per tick — a per-chunk hand-back of one microbatch
+        # (tok_bytes/M) — scan-weighted over the fwd ticks + the mirrored
+        # bwd, exactly how collective_inventory weighs the traced scan.
+        from distributed_pytorch_tpu.models import pipeline as pipe_mod
+        pcfg = dataclasses.replace(cfg, pp_stages=pipe)
+        if pipe_mod.resolve_schedule(pcfg) == "1f1b":
+            vpp = pipe_mod.resolve_vpp(pcfg)
+            M = pcfg.pp_microbatches
+            if M <= 0:  # run_pipeline's auto pick, model-level batch
+                M = min(train_cfg.batch_size, 2 * pipe)
+                while train_cfg.batch_size % M:
+                    M -= 1
+            sched = pipe_mod._build_1f1b_schedule(pipe, vpp, M)
+            entries.append({"origin": "pipe-1f1b", "family": "ppermute",
+                            "axis": "pipe", "vpp": vpp,
+                            "n_microbatches": M,
+                            "ticks": 2 * sched.ticks,
+                            "bytes": (2 * sched.ticks * accum
+                                      * tok_bytes // M)})
+        else:
+            entries.append({"origin": "pipe-boundary",
+                            "family": "ppermute", "axis": "pipe",
+                            "bytes": 2 * (pipe - 1) * accum * tok_bytes})
     return entries, findings
 
 
@@ -472,6 +495,17 @@ def audit_train_cell(preset: str, cfg: LLMConfig, recipe: str,
                          n_params=_n_params(cfg))
     entries, findings = derived_train_comms(cfg, recipe, sizes, tcfg,
                                             accum=accum)
+    if variant == "offload":
+        # ZeRO-Offload PCIe legs (train/offload.py): full fp32 grads
+        # stream to the host and updated params stream back, once per
+        # optimizer step per process (the device_get gathers shards) —
+        # host transfers, not collectives, so their own family
+        p4_full = _n_params(cfg) * 4
+        entries = entries + [
+            {"origin": "offload-grads", "family": "host_transfer",
+             "direction": "to_host", "bytes": p4_full},
+            {"origin": "offload-params", "family": "host_transfer",
+             "direction": "to_device", "bytes": p4_full}]
     report.derived = entries
     report.findings.extend(findings)
     if not trace:
@@ -503,6 +537,25 @@ def audit_train_cell(preset: str, cfg: LLMConfig, recipe: str,
             "overlap-rings-missing", "error", "inventory", "train_step",
             "overlap=on with per-micro-step gathers promised ppermute "
             "rings (ops/collective_matmul.py) but the trace has none"))
+    if variant == "offload":
+        # the host half of the split step: the optax update traced over
+        # abstract state. Contract: params + opt_state donated AND fully
+        # consumed (the moments update in place in host RAM — the
+        # kv_tier donated copy-program idiom), and ZERO collectives (a
+        # collective in a host program would mean the update somehow
+        # still spans the mesh).
+        from distributed_pytorch_tpu.train import offload as offload_mod
+        htr = offload_mod.trace_host_update(
+            tx, state_shapes, anomaly=getattr(tcfg, "anomaly", "warn"))
+        don = donation_report(htr)
+        report.donation["host_update"] = don
+        _donation_findings(report, "host_update", don)
+        hinv = collective_inventory(htr)
+        if hinv:
+            report.findings.append(Finding(
+                "unexpected-comms", "error", "inventory", "host_update",
+                "collective(s) in the host optimizer update: " +
+                ", ".join(c["prim"] for c in hinv)))
     return report
 
 
@@ -760,6 +813,14 @@ def check_matrix(presets: Optional[Iterable[str]] = None,
                 "gpt2_124m", cfg_124, "fsdp", (2, 1),
                 trace=trace_mode != "off", overlap="on", accum=accum,
                 variant=variant))
+        # ZeRO-Offload host-transfer audit (ISSUE 19): PCIe legs in the
+        # derived model + the host update's donation/zero-collective
+        # contract
+        if progress:
+            progress("train/gpt2_124m/fsdp/2x1/offload [trace]")
+        reports.append(audit_train_cell(
+            "gpt2_124m", cfg_124, "fsdp", (2, 1),
+            trace=trace_mode != "off", variant="offload"))
         for recipe, grid, chunked in DECODE_CELLS:
             if recipe not in recipes:
                 continue
@@ -798,6 +859,9 @@ def check_cells(keys: Iterable[str],
             out.append(audit_train_cell(
                 preset, cfg, recipe, grid, trace=trace, overlap="on",
                 accum=int(variant[-1]), variant=variant))
+        elif variant == "offload":
+            out.append(audit_train_cell(preset, cfg, recipe, grid,
+                                        trace=trace, variant=variant))
         else:
             out.append(audit_train_cell(preset, cfg, recipe, grid,
                                         trace=trace))

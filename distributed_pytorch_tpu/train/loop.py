@@ -372,6 +372,20 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
     total, active = count_params(state.params, model_cfg)
     say(f"params: {total / 1e6:.2f}M total, {active / 1e6:.2f}M active")
 
+    # ---- ZeRO-Offload gate (train/offload.py, ISSUE 19) ------------------
+    # OFFLOAD knob / TrainConfig.offload; 'auto' offloads exactly when the
+    # in-HBM memplan busts the per-chip budget and the offload plan fits.
+    from distributed_pytorch_tpu.train import offload as offload_mod
+    offload_on = offload_mod.resolve_offload(model_cfg, train_cfg, sizes)
+    if offload_on:
+        # the moments live in host RAM from here on: the fresh init moves
+        # over now; a checkpoint restore below restores them straight to
+        # the host via the per-leaf sharding tree
+        state = state.replace(opt_state=jax.device_put(
+            state.opt_state, offload_mod.host_device()))
+        say("offload: optimizer moments -> host RAM (ZeRO-Offload; update "
+            "on host, params streamed back per step)")
+
     start_step = 0
     ckpt_root = os.path.join("checkpoints", train_cfg.file_name)
     resume_info = None  # (path, skipped) for the telemetry recovery event
@@ -382,7 +396,9 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
         # (blake2b manifest verification, train/checkpoint.py) — a flipped
         # byte in the newest save falls back to the previous good one
         # instead of crashing the rejoin (ISSUE 13)
-        restored = ckpt.restore_latest(ckpt_root, abstract, state_sharding)
+        restore_sharding = (offload_mod.host_state_sharding(state_sharding)
+                            if offload_on else state_sharding)
+        restored = ckpt.restore_latest(ckpt_root, abstract, restore_sharding)
         if restored is not None:
             state, last, skipped = restored
             start_step = int(jax.device_get(state.step))
@@ -392,7 +408,7 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
             say(f"resumed from {last} at step {start_step}")
 
     train_step = make_train_step(model, tx, model_cfg, train_cfg, mesh,
-                                 state_sharding)
+                                 state_sharding, offload=offload_on)
     # AOT program store (parallel/aot_store.py, ISSUE 18): with the
     # AOT_STORE knobs on, the train step is resolved through the store —
     # a hit hands the loop a deserialized executable (restart-to-first-
@@ -402,6 +418,12 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
     # surviving gang's restart hits.
     from distributed_pytorch_tpu.parallel import aot_store as aot_mod
     _store = aot_mod.resolve_store()
+    if _store is not None and offload_on:
+        # the offload step is a host-orchestrated pair of programs, not
+        # one AOT-serializable executable; skip the store rather than
+        # cache a step that isn't the one running
+        say("aot store: skipped (offload step is not a single program)")
+        _store = None
     if _store is not None:
         train_step = aot_mod.wrap_train_step(
             _store, train_step, state, model_cfg, train_cfg, mesh,
@@ -410,7 +432,17 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
             f"{'hit' if _store.hits else 'miss'} "
             f"(hits={_store.hits} misses={_store.misses} "
             f"compile_ms={_store.compile_ms:.0f} root={_store.root})")
-    eval_step = make_eval_step(model, train_cfg, mesh, state_sharding)
+    # eval never touches the optimizer state; with offload the moments sit
+    # on the host and a TrainState-shaped in_shardings would drag 2x-params
+    # of bytes back through PCIe every eval — so the eval program sees a
+    # view of the state with opt_state stripped (and a matching sharding).
+    if offload_on:
+        eval_sharding = state_sharding.replace(opt_state=())
+        eval_view = lambda s: s.replace(opt_state=())  # noqa: E731
+    else:
+        eval_sharding = state_sharding
+        eval_view = lambda s: s  # noqa: E731
+    eval_step = make_eval_step(model, train_cfg, mesh, eval_sharding)
 
     # ---- loop ------------------------------------------------------------
     stats = {"train_losses": [], "val_losses": [], "step_times": [],
@@ -446,9 +478,36 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
     # the delta lands in the timeline, stats.json, and bench JSON
     try:
         memplan_pred_gb, memplan_breakdown = \
-            memplan.predicted_train_peak_gb(model_cfg, train_cfg, sizes)
+            memplan.predicted_train_peak_gb(model_cfg, train_cfg, sizes,
+                                            offload=offload_on)
     except Exception as e:  # noqa: BLE001 — planning never stops a run
         memplan_pred_gb, memplan_breakdown = None, {"error": repr(e)}
+    # 1f1b schedule record (ISSUE 19): the static (tick, stage, chunk,
+    # phase) timeline + bubble summary for the run's actual S/vpp/M —
+    # what the CPU A/B test checks against the (S-1)/(vpp*M) model, and
+    # what a TPU window compares the profiler trace to. Static table, no
+    # device work; per-phase rows only for small tables.
+    if model_cfg.pp_stages > 1:
+        from distributed_pytorch_tpu.models import pipeline as pipe_mod
+        if pipe_mod.resolve_schedule(model_cfg) == "1f1b":
+            S = model_cfg.pp_stages
+            vpp = pipe_mod.resolve_vpp(model_cfg)
+            Mpp = model_cfg.pp_microbatches
+            if Mpp <= 0:  # mirror run_pipeline's auto pick
+                Mpp = min(b_glob, 2 * S)
+                while b_glob % Mpp:
+                    Mpp -= 1
+            sched_rows, sched_sum = pipe_mod.schedule_timeline(S, vpp, Mpp)
+            say(f"pp schedule: 1f1b S={S} vpp={vpp} M={Mpp} | bubble "
+                f"{sched_sum['bubble_frac']:.3f} (model (S-1)/(vpp*M)="
+                f"{sched_sum['bubble_model']:.3f})")
+            if tel.enabled:
+                tel.record_step(event="pp_schedule", it=start_step,
+                                **sched_sum)
+                if len(sched_rows) <= 256:
+                    for r in sched_rows:
+                        tel.record_step(event="pp_phase", it=start_step,
+                                        **r)
     # device-free spec-table validation (parallel/shardcheck.py): surface
     # sharding mistakes — replicated-large, dead axes — at startup, where
     # they cost a log line instead of an OOM'd or silently slow run.
@@ -559,7 +618,7 @@ def train(model_cfg: LLMConfig, train_cfg: TrainConfig,
 
             if train_cfg.eval and it % train_cfg.eval_interval == 0:
                 t0 = time.perf_counter()
-                ev = estimate_loss(eval_step, state,
+                ev = estimate_loss(eval_step, eval_view(state),
                                    {"train": eval_train_loader,
                                     "val": val_loader},
                                    train_cfg.eval_iters)

@@ -49,6 +49,14 @@ _OPT_MULT = {"adamw": 2.0, "lion": 1.0, "adafactor": 0.1}
 
 _FUDGE = 1.15  # fragmentation + XLA temporaries
 
+# HBM the runtime itself holds (program binaries, infeed buffers, XLA
+# runtime scratch) — spec-sheet GiB minus this is what an allocation can
+# actually get. Applied to plan_memory's fit check only: a plan within
+# 0.9 GiB of the spec number OOMs in practice, and the 7B rung's
+# "in-HBM moments DO NOT FIT / offload fits" decision depends on not
+# pretending that margin exists.
+_RUNTIME_RESERVE_GB = 0.9
+
 
 def device_hbm_gb() -> float:
     try:
@@ -174,24 +182,56 @@ def _expert_param_count(cfg: LLMConfig) -> int:
     return cfg.n_layer * cfg.n_exp * per_expert
 
 
+# host<->device link bandwidth for the offload PCIe cost line (GiB/s per
+# chip; v5e PCIe gen3 x16 effective — conservative, like _FUDGE)
+_PCIE_GBPS = 16.0
+
+
 def estimate_peak_gb(cfg: LLMConfig, recipe: str, micro_batch: int,
                      policy: str, dp: int, sp: int = 1, ep: int = 1,
                      optimizer: str = "adamw",
-                     n_params: Optional[int] = None) -> tuple[float, dict]:
+                     n_params: Optional[int] = None,
+                     offload: bool = False,
+                     pipe: int = 1, tp: int = 1) -> tuple[float, dict]:
     """(est peak GiB per device, breakdown dict). `policy` in
     'none'|'attn'|'block'. `micro_batch` is per-data-shard sequences.
     `ep`: 'expert' mesh-axis size — stacked (E, ...) expert leaves (and
     their moments/accumulators) divide by it on top of the recipe's data
-    sharding."""
+    sharding.
+
+    `pipe`: 'pipe' mesh-axis size — each stage holds n_layer/pipe of the
+    block params (and their grads/moments), so those divide by `pipe`;
+    the embedding table does NOT (the worst stage keeps it, and tied
+    lm_head means the first stage is that stage). Activations do NOT
+    divide: under 1F1B a stage holds up to `pipe` in-flight microbatches
+    of its n_layer/pipe layers, which cancels back to one full model's
+    worth of per-microbatch activations.
+
+    `tp`: 'model' mesh-axis size — the matmul weights (qkv/proj, MLP
+    up/down; the _TP_TABLE rows in parallel/sharding.py) column/row-split
+    over 'model', so the block params divide by `tp` on top of any pipe
+    and data sharding; the embedding stays whole per model-shard.
+
+    `offload` (ZeRO-Offload, train/offload.py) moves the optimizer
+    moments to host RAM: the 'opt' HBM row goes to zero and two
+    NOT-summed rows appear after the total (the `host_kv_tier`
+    precedent): 'host_opt' — host-RAM GiB the moments + fp32 master
+    params occupy per process — and 'pcie_gb_per_step' — the 8P-bytes
+    per-step transfer bill (4P grads down + 4P params up, per-device
+    share) that buys the HBM back."""
     P = n_params if n_params is not None else param_count(cfg)
     p_div = dp if recipe in _PARAM_SHARDED else 1
     o_div = dp if recipe in _OPT_SHARDED else 1
     g_div = dp if recipe in _GRAD_SHARDED else 1
     Pe = _expert_param_count(cfg) if ep > 1 else 0
     Pd = P - Pe  # dense (non-expert-stacked) params
+    mdl_div = max(pipe, 1) * max(tp, 1)
+    if mdl_div > 1:
+        emb = cfg.vocab_size * cfg.n_embd
+        Pd = (Pd - emb) / mdl_div + emb  # worst shard keeps the embedding
 
     def _split(div):
-        return Pd / div + Pe / (div * ep)
+        return Pd / div + Pe / (div * ep * max(pipe, 1))
 
     params_b = _split(p_div) * 4
     opt_b = _split(o_div) * 4 * _OPT_MULT.get(optimizer, 2.0)
@@ -220,7 +260,7 @@ def estimate_peak_gb(cfg: LLMConfig, recipe: str, micro_batch: int,
 
     breakdown = {
         "params": params_b / 2 ** 30,
-        "opt": opt_b / 2 ** 30,
+        "opt": 0.0 if offload else opt_b / 2 ** 30,
         "grads": grads_b / 2 ** 30,
         "acts": act_b / 2 ** 30,
         "loss": loss_b / 2 ** 30,
@@ -230,6 +270,13 @@ def estimate_peak_gb(cfg: LLMConfig, recipe: str, micro_batch: int,
         breakdown["moe_dispatch"] = _moe_dispatch_bytes(
             cfg, tokens, ep) / 2 ** 30
     total = sum(breakdown.values()) * _FUDGE
+    if offload:
+        # host rows are reported AFTER total — host RAM and PCIe time,
+        # never HBM (the estimate_serving_gb host_kv_tier precedent)
+        breakdown["host_opt"] = (opt_b + _split(o_div) * 4) / 2 ** 30
+        breakdown["pcie_gb_per_step"] = _split(g_div) * 8 / 2 ** 30
+        breakdown["pcie_s_per_step"] = (
+            breakdown["pcie_gb_per_step"] / _PCIE_GBPS)
     return total, {k: round(v, 3) for k, v in breakdown.items()}
 
 
@@ -386,7 +433,7 @@ def plan_decode_slots(model_cfg: LLMConfig, max_len: int, *,
 
 def predicted_train_peak_gb(model_cfg: LLMConfig, train_cfg: TrainConfig,
                             mesh_sizes: Optional[dict] = None,
-                            ) -> tuple[float, dict]:
+                            offload: bool = False) -> tuple[float, dict]:
     """Predicted per-device peak for the run configuration ACTUALLY in
     flight (not the planner's pick): the micro-batch / remat policy /
     recipe the loop is about to compile, priced by estimate_peak_gb.
@@ -398,7 +445,8 @@ def predicted_train_peak_gb(model_cfg: LLMConfig, train_cfg: TrainConfig,
     return estimate_peak_gb(
         model_cfg, train_cfg.parallelism, train_cfg.batch_size, policy,
         dp=sizes.get("data", 1), sp=sizes.get("seq", 1),
-        ep=sizes.get("expert", 1), optimizer=train_cfg.optimizer)
+        ep=sizes.get("expert", 1), optimizer=train_cfg.optimizer,
+        offload=offload)
 
 
 def watermark_report(predicted_gb: Optional[float]) -> list[dict]:
@@ -428,7 +476,8 @@ def watermark_report(predicted_gb: Optional[float]) -> list[dict]:
 def plan_memory(model_cfg: LLMConfig, train_cfg: TrainConfig, *,
                 n_devices: Optional[int] = None,
                 hbm_gb: Optional[float] = None,
-                preset_name: str = "custom") -> HBMPlan:
+                preset_name: str = "custom",
+                offload: bool = False) -> HBMPlan:
     """Pick (micro_batch, remat policy, grad_accum) for the config under
     the recipe's sharding and the per-chip HBM budget.
 
@@ -447,6 +496,7 @@ def plan_memory(model_cfg: LLMConfig, train_cfg: TrainConfig, *,
                         ep_size=train_cfg.ep_size, sp_size=train_cfg.sp_size,
                         pp_size=train_cfg.pp_size, dp_size=train_cfg.dp_size)
     dp, sp, ep = plan.data, plan.seq, plan.expert
+    pipe, tp = plan.pipe, plan.model
     budget = hbm_gb if hbm_gb is not None else device_hbm_gb()
     n_params = param_count(model_cfg)
     T = model_cfg.block_size
@@ -462,13 +512,15 @@ def plan_memory(model_cfg: LLMConfig, train_cfg: TrainConfig, *,
         for policy in ("none", "attn", "block"):
             est, breakdown = estimate_peak_gb(
                 model_cfg, recipe, mb, policy, dp, sp, ep,
-                optimizer=train_cfg.optimizer, n_params=n_params)
+                optimizer=train_cfg.optimizer, n_params=n_params,
+                offload=offload, pipe=pipe, tp=tp)
             cand = HBMPlan(
                 preset=preset_name, recipe=recipe, micro_batch=mb,
                 grad_accum=accum, act_recomp=policy != "none",
                 act_recomp_policy=policy if policy != "none" else "attn",
                 est_peak_gb=round(est, 3), hbm_gb=budget,
-                fits=est <= budget, breakdown_gb=breakdown)
+                fits=est <= budget - _RUNTIME_RESERVE_GB,
+                breakdown_gb=breakdown)
             if cand.fits:
                 score = mb / flop_mult[policy]
                 if best is None or score > best[0]:
@@ -482,3 +534,70 @@ def plan_memory(model_cfg: LLMConfig, train_cfg: TrainConfig, *,
             f"micro-batch with dp={dp}, T={T} (need divisibility by "
             f"micro_batch*dp*T)")
     return fallback
+
+
+def _main(argv: Optional[list] = None) -> int:
+    """`python -m distributed_pytorch_tpu.train.memplan --preset gpt2_7b
+    --offload`: price a preset/recipe against a per-chip HBM budget,
+    device-free. Exits non-zero when the plan does not fit — the loud
+    failure the 7B rung relies on with offload off."""
+    import argparse
+    import json as _json
+
+    from distributed_pytorch_tpu.config import PRESETS, TrainConfig as TC
+
+    ap = argparse.ArgumentParser(
+        description="static HBM planner (closed-form, no compile)")
+    ap.add_argument("--preset", default="gpt2_7b", choices=sorted(PRESETS))
+    ap.add_argument("--recipe", default="fsdp")
+    ap.add_argument("--devices", type=int, default=8)
+    ap.add_argument("--pp-size", type=int, default=1,
+                    help="pipe mesh-axis size (the pp recipe prices "
+                         "pipe=1 — all params on every chip — without it)")
+    ap.add_argument("--tp-size", type=int, default=1)
+    ap.add_argument("--hbm-gb", type=float, default=None,
+                    help="per-chip budget (default: detected, 16 on CPU)")
+    ap.add_argument("--offload", action="store_true",
+                    help="price with the optimizer moments in host RAM")
+    ap.add_argument("--total-batch-size", type=int, default=None)
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    cfg = PRESETS[args.preset]()
+    tbs = args.total_batch_size or (args.devices * cfg.block_size * 8)
+    tc = TC(batch_size=1, total_batch_size=tbs, max_iters=1,
+            parallelism=args.recipe, warmup_steps=0,
+            pp_size=args.pp_size, tp_size=args.tp_size)
+    plan = plan_memory(cfg, tc, n_devices=args.devices,
+                       hbm_gb=args.hbm_gb, preset_name=args.preset,
+                       offload=args.offload)
+    if args.json:
+        print(_json.dumps({**dataclasses.asdict(plan),
+                           "offload": args.offload}, indent=2))
+    else:
+        print(plan.summary())
+        if args.offload:
+            base = plan_memory(cfg, tc, n_devices=args.devices,
+                               hbm_gb=args.hbm_gb, preset_name=args.preset,
+                               offload=False)
+            delta = base.est_peak_gb - plan.est_peak_gb
+            bd = plan.breakdown_gb
+            print(f"[offload] HBM delta vs in-HBM moments: "
+                  f"{-delta:+.2f} GiB/chip (in-HBM plan "
+                  f"{base.est_peak_gb:.2f} GiB, "
+                  f"{'fits' if base.fits else 'DOES NOT FIT'}) | "
+                  f"host_opt {bd.get('host_opt', 0.0):.2f} GiB RAM, "
+                  f"pcie {bd.get('pcie_gb_per_step', 0.0):.2f} GiB/step "
+                  f"(~{bd.get('pcie_s_per_step', 0.0):.3f} s at "
+                  f"{_PCIE_GBPS:.0f} GiB/s)")
+    if not plan.fits:
+        print(f"[memplan] FAIL: {args.preset}/{args.recipe} does not fit "
+              f"{plan.hbm_gb:.0f} GiB/chip"
+              + ("" if args.offload else
+                 " — retry with --offload (ZeRO-Offload host optimizer)"))
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

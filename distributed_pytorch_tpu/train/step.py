@@ -57,37 +57,22 @@ def _grad_shardings(params, recipe: str, mesh: Mesh):
     return shd.named(mesh, g_specs)
 
 
-def make_train_step(model, tx: optax.GradientTransformation,
-                    model_cfg: LLMConfig, train_cfg: TrainConfig,
-                    mesh: Optional[Mesh] = None,
-                    state_sharding: Optional[Any] = None):
-    """Build the jitted `train_step(state, x, y) -> (state, metrics)`.
+def make_grads_fn(model, model_cfg: LLMConfig, train_cfg: TrainConfig,
+                  mesh: Optional[Mesh] = None):
+    """Build the gradient half of the train step — the micro-batch
+    accumulation scan with sharded-accumulator constraints, gather
+    hoisting and poison fault injection — shared verbatim by the in-HBM
+    `make_train_step` and the ZeRO-Offload device program
+    (train/offload.py), so the two paths cannot diverge numerically.
 
-    x, y: (accum, B_global, T) int32 — the whole logical batch for one
-    optimizer step; axis 0 is scanned (grad accumulation, reference
-    single-gpu/train.py:338-345).
-
-    Overlap interaction (ops/collective_matmul.py): the resolved OVERLAP
-    mode is published for the trace so the model's matmul call sites can
-    ring their ZeRO-3 param gathers. With grad accumulation (accum > 1)
-    the per-layer gathers are instead HOISTED out of the micro-batch scan:
-    params are constrained replicated ONCE before the scan (one all-gather
-    per optimizer step instead of one per accumulation micro-step — the
-    standard FSDP no-reshard-between-microbatches trade: full fp32 params
-    resident for the step), gradients still reduce-scatter per micro-step
-    through the sharded-accumulator constraint, and the in-model rings
-    stand down via context.gathers_hoisted.
+    Returns `(grads_fn, overlap_mode)` where
+    `grads_fn(params, moe_state, step, x, y) -> (grads, new_moe, losses)`.
+    The caller is responsible for wrapping the trace in
+    `context.use_mesh(mesh)` / `context.use_overlap(overlap_mode, recipe)`.
     """
     from distributed_pytorch_tpu.ops import collective_matmul as cm
     recipe = train_cfg.parallelism
-    # Anomaly guard (ISSUE 10): 'warn' adds a device-side nonfinite flag
-    # to the step metrics (drained with them at sync boundaries — zero
-    # extra host round-trips); 'skip' additionally withholds the
-    # optimizer/moe update for a poisoned (NaN/inf loss or grad-norm)
-    # step so training keeps going on the last good params. 'off'
-    # removes the metric entirely.
-    anomaly = getattr(train_cfg, "anomaly", "warn")
-    # Fault injection for the guard (same spirit as scripts/
+    # Fault injection for the anomaly guard (same spirit as scripts/
     # fault_inject.py on the serving side): TRAIN_POISON_IT=<k> makes
     # iteration k's batch produce NaN loss AND NaN grads — exactly what
     # a corrupt data shard does — so the skip/record/resume path is
@@ -114,29 +99,13 @@ def make_train_step(model, tx: optax.GradientTransformation,
             new_moe = moe_state
         return loss, new_moe
 
-    # one trace serves the whole run: batch shapes are fixed by the config
-    # and state.step is a traced value. A mid-run retrace means a shape or
-    # weak-type leak — the guard counts it (and the loop's expect(0)
-    # window pins the offending iteration); see obs/retrace.py.
-    guard = TraceGuard("train.step")
-
-    def train_step(state: TrainState, x: jnp.ndarray, y: jnp.ndarray):
-        guard.mark()  # trace-time side effect
-        # publish the mesh (+ overlap mode) for the duration of TRACING:
-        # sequence-parallel attention (ops/ring_attention.py) reads the
-        # mesh to shard_map over 'seq'; the collective-matmul dispatcher
-        # reads (mode, recipe) to decide whether to ring param gathers
-        with context.use_mesh(mesh), \
-                context.use_overlap(overlap_mode, recipe):
-            return _train_step_body(state, x, y)
-
-    def _train_step_body(state: TrainState, x: jnp.ndarray, y: jnp.ndarray):
+    def grads_fn(params, moe_state, step, x, y):
         accum = x.shape[0]
         base_rng = jax.random.fold_in(
-            jax.random.PRNGKey(train_cfg.seed), state.step)
+            jax.random.PRNGKey(train_cfg.seed), step)
 
         if mesh is not None and recipe in _SHARDED_GRAD_RECIPES:
-            g_sh = _grad_shardings(state.params, recipe, mesh)
+            g_sh = _grad_shardings(params, recipe, mesh)
 
             def grad_constraint(g):
                 return jax.tree_util.tree_map(
@@ -156,12 +125,12 @@ def make_train_step(model, tx: optax.GradientTransformation,
             repl = NamedSharding(mesh, P())
             loss_params = jax.tree_util.tree_map(
                 lambda p: jax.lax.with_sharding_constraint(p, repl),
-                state.params)
+                params)
         else:
-            loss_params = state.params
+            loss_params = params
 
         zeros = grad_constraint(jax.tree_util.tree_map(
-            lambda p: jnp.zeros(p.shape, jnp.float32), state.params))
+            lambda p: jnp.zeros(p.shape, jnp.float32), params))
 
         def micro_step(carry, xs):
             g_acc, moe_state = carry
@@ -175,18 +144,82 @@ def make_train_step(model, tx: optax.GradientTransformation,
 
         with context.hoisted_gathers(hoist):
             (g_acc, new_moe), losses = jax.lax.scan(
-                micro_step, (zeros, state.moe_state),
+                micro_step, (zeros, moe_state),
                 (x, y, jnp.arange(accum)))
         grads = jax.tree_util.tree_map(lambda g: g / accum, g_acc)
 
         if poison_it >= 0:
-            # fault injection (see make_train_step): NaN-bomb this
-            # iteration's loss and gradients, as a poisoned batch would
-            bomb = jnp.where(state.step == poison_it,
+            # fault injection (see above): NaN-bomb this iteration's
+            # loss and gradients, as a poisoned batch would
+            bomb = jnp.where(step == poison_it,
                              jnp.float32(jnp.nan), jnp.float32(1.0))
             losses = losses * bomb
             grads = jax.tree_util.tree_map(lambda g: g * bomb, grads)
+        return grads, new_moe, losses
 
+    return grads_fn, overlap_mode
+
+
+def make_train_step(model, tx: optax.GradientTransformation,
+                    model_cfg: LLMConfig, train_cfg: TrainConfig,
+                    mesh: Optional[Mesh] = None,
+                    state_sharding: Optional[Any] = None,
+                    offload: bool = False):
+    """Build the jitted `train_step(state, x, y) -> (state, metrics)`.
+
+    x, y: (accum, B_global, T) int32 — the whole logical batch for one
+    optimizer step; axis 0 is scanned (grad accumulation, reference
+    single-gpu/train.py:338-345).
+
+    Overlap interaction (ops/collective_matmul.py): the resolved OVERLAP
+    mode is published for the trace so the model's matmul call sites can
+    ring their ZeRO-3 param gathers. With grad accumulation (accum > 1)
+    the per-layer gathers are instead HOISTED out of the micro-batch scan:
+    params are constrained replicated ONCE before the scan (one all-gather
+    per optimizer step instead of one per accumulation micro-step — the
+    standard FSDP no-reshard-between-microbatches trade: full fp32 params
+    resident for the step), gradients still reduce-scatter per micro-step
+    through the sharded-accumulator constraint, and the in-model rings
+    stand down via context.gathers_hoisted.
+
+    `offload=True` dispatches to the ZeRO-Offload split step
+    (train/offload.py): the device program stops at the gradients, the
+    optimizer state lives in host RAM and the AdamW update runs there.
+    """
+    if offload:
+        from distributed_pytorch_tpu.train import offload as offload_mod
+        return offload_mod.make_offload_train_step(
+            model, tx, model_cfg, train_cfg, mesh, state_sharding)
+    recipe = train_cfg.parallelism
+    # Anomaly guard (ISSUE 10): 'warn' adds a device-side nonfinite flag
+    # to the step metrics (drained with them at sync boundaries — zero
+    # extra host round-trips); 'skip' additionally withholds the
+    # optimizer/moe update for a poisoned (NaN/inf loss or grad-norm)
+    # step so training keeps going on the last good params. 'off'
+    # removes the metric entirely.
+    anomaly = getattr(train_cfg, "anomaly", "warn")
+    grads_fn, overlap_mode = make_grads_fn(model, model_cfg, train_cfg,
+                                           mesh)
+
+    # one trace serves the whole run: batch shapes are fixed by the config
+    # and state.step is a traced value. A mid-run retrace means a shape or
+    # weak-type leak — the guard counts it (and the loop's expect(0)
+    # window pins the offending iteration); see obs/retrace.py.
+    guard = TraceGuard("train.step")
+
+    def train_step(state: TrainState, x: jnp.ndarray, y: jnp.ndarray):
+        guard.mark()  # trace-time side effect
+        # publish the mesh (+ overlap mode) for the duration of TRACING:
+        # sequence-parallel attention (ops/ring_attention.py) reads the
+        # mesh to shard_map over 'seq'; the collective-matmul dispatcher
+        # reads (mode, recipe) to decide whether to ring param gathers
+        with context.use_mesh(mesh), \
+                context.use_overlap(overlap_mode, recipe):
+            return _train_step_body(state, x, y)
+
+    def _train_step_body(state: TrainState, x: jnp.ndarray, y: jnp.ndarray):
+        grads, new_moe, losses = grads_fn(state.params, state.moe_state,
+                                          state.step, x, y)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
 

@@ -275,6 +275,12 @@ class Supervisor:
         if mode == "off" or (mode == "auto"
                              and not cfg_mod.knob("AOT_STORE_DIR")):
             return None
+        if cfg_mod.knob("OFFLOAD") == "on":
+            # the ZeRO-Offload step (train/offload.py) is a host-
+            # orchestrated program pair, not one AOT-serializable
+            # executable — the loop skips the store, so pre-warming it
+            # would compile a step that never runs
+            return None
         cmd = [sys.executable, "-m",
                "distributed_pytorch_tpu.parallel.aot_store",
                "--warm-train", "--hosts", str(n)]

@@ -44,6 +44,11 @@ def build_args(argv=None):
     p.add_argument("--hosts", type=int, default=2)
     p.add_argument("--mode", choices=["kill", "kill-hold", "none"],
                    default="kill")
+    p.add_argument("--recipe", choices=["fsdp", "pp"], default="fsdp",
+                   help="worker parallelism: fsdp (dp over hosts) or pp "
+                        "(interleaved-1F1B pipeline over hosts; kill-hold "
+                        "is fsdp-only — a 1-host rung cannot hold a "
+                        "2-stage pipe)")
     p.add_argument("--max-iters", type=int, default=40)
     p.add_argument("--ckpt-interval", type=int, default=5)
     p.add_argument("--seed", type=int, default=1729)
@@ -55,7 +60,12 @@ def build_args(argv=None):
     p.add_argument("--log-dir", type=str, default="",
                    help="working dir for checkpoints/runs/logs "
                         "(default: runs/fault_inject_train_<ts>)")
-    return p.parse_args(argv)
+    args = p.parse_args(argv)
+    if args.recipe == "pp" and args.mode == "kill-hold":
+        p.error("--recipe pp does not support --mode kill-hold (the "
+                "rung-down re-mesh shrinks to 1 host, which cannot hold "
+                "a 2-stage pipeline)")
+    return args
 
 
 # Tiny model, the tests/test_multihost.py experiment scaled for speed.
@@ -64,8 +74,16 @@ def build_args(argv=None):
 # GLOBAL batch (and the counter-based loader's coverage) is unchanged,
 # which is exactly why the re-meshed leg continues the same experiment.
 def _train_argv(args, run_name: str) -> list[str]:
+    recipe = getattr(args, "recipe", "fsdp")
+    extra = []
+    if recipe == "pp":
+        # 2 hosts x 1 device -> pipe=2 (pp_size carves the mesh, the
+        # loop links pp_stages to it), one layer per stage, the
+        # interleaved-1F1B schedule (models/pipeline.py) — the CI smoke
+        # that the gang restart replays the SAME pipeline timeline
+        extra = ["--pp_size", "2", "--pp_schedule", "1f1b"]
     return ["--dataset", "synthetic", "--platform", "cpu",
-            "--parallelism", "fsdp",
+            "--parallelism", recipe, *extra,
             "--file_name", run_name,
             "--seed", str(args.seed),
             "--max_iters", str(args.max_iters),
@@ -194,6 +212,7 @@ def main(argv=None) -> int:
     base_losses = (base["stats"] or {}).get("train_losses") or []
 
     out = {"mode": args.mode, "hosts": args.hosts,
+           "recipe": args.recipe,
            "max_iters": args.max_iters,
            "ckpt_interval": args.ckpt_interval,
            "baseline_completed": base["rc"] == 0,

@@ -130,12 +130,29 @@ def time_variant(batch: int, attn_impl: str, act_recomp: bool,
     recipe = _os.environ.get("SWEEP_RECIPE", "single")
     moe_impl = _os.environ.get("SWEEP_MOE", "")
     ep_size = int(_os.environ.get("SWEEP_EP", "1"))
+    pp_size = int(_os.environ.get("SWEEP_PP", "1"))
+    cpu_devs = int(_os.environ.get("SWEEP_CPU_DEVICES", "0"))
+    if cpu_devs:
+        # pipeline legs on a dev box: carve virtual CPU devices so the
+        # pipe axis is a real mesh axis (must precede any jax device op)
+        from distributed_pytorch_tpu.compat import request_cpu_devices
+        request_cpu_devices(cpu_devs)
     moe_kw = {}
     if moe_impl:
         # same MoE shape as bench.py's moe_* legs so the two measure the
         # same model (active params stay 124M-class)
         moe_kw = dict(moe=True, n_exp=8, n_shared=1, n_act=3, up_dim=1024,
                       moe_impl=moe_impl)
+    if pp_size > 1:
+        # the pipe mesh axis and the model's stacked-stage count are one
+        # decision (train/loop.py links them the same way)
+        moe_kw["pp_stages"] = pp_size
+    if _os.environ.get("SWEEP_TINY") == "1":
+        # CPU-provable shape for the pipeline legs: a 124M step takes
+        # minutes per iteration on a dev box; the schedule A/B only
+        # needs enough layers for vpp=2 chunks, not the real width
+        moe_kw.update(n_layer=4, n_embd=256, n_head=4, n_kv_heads=4,
+                      up_dim=512)
     model_cfg = PRESETS[preset](act_recomp=act_recomp,
                                 act_recomp_policy="attn",
                                 loss_impl=loss_impl, **moe_kw)
@@ -143,7 +160,7 @@ def time_variant(batch: int, attn_impl: str, act_recomp: bool,
     train_cfg = TrainConfig(
         dataset="synthetic", total_batch_size=batch * n_dev * 1024,
         batch_size=batch, max_iters=iters, parallelism=recipe,
-        attn_impl=attn_impl, ep_size=ep_size,
+        attn_impl=attn_impl, ep_size=ep_size, pp_size=pp_size,
         eval=False, save_model=False, save_stats=False,
         compute_dtype="bfloat16")
 
@@ -151,11 +168,14 @@ def time_variant(batch: int, attn_impl: str, act_recomp: bool,
         mesh = None
         if recipe != "single":
             from distributed_pytorch_tpu.parallel.mesh import mesh_for
-            mesh = mesh_for(recipe, ep_size=ep_size)
+            mesh = mesh_for(recipe, ep_size=ep_size, pp_size=pp_size)
         model, tx, state, state_sh = create_train_state(model_cfg,
                                                         train_cfg, mesh)
+        # the sweep honors the OFFLOAD knob the same way the loop's gate
+        # does for an explicit 'on' — the 1f1b+offload A/B leg
+        from distributed_pytorch_tpu.config import knob
         step = make_train_step(model, tx, model_cfg, train_cfg, mesh,
-                               state_sh)
+                               state_sh, offload=knob("OFFLOAD") == "on")
         rng = jax.random.PRNGKey(0)
         x = jax.random.randint(rng, (1, batch * n_dev, 1024), 0, 50304,
                                jnp.int32)
@@ -402,6 +422,29 @@ def main():
             (8, "auto", False, "fused", {**D, **I8, "FLASH_DECODE": "on",
                                          "SWEEP_QUANT_W": "1",
                                          "SWEEP_PRESET": "gpt2_350m"}),
+        ]
+    elif args.variants == "pipeline":
+        # interleaved-1F1B vs carry vs 1f1b+offload inside the real pp
+        # train step (ISSUE 19), on CPU-provable shapes: 2 virtual CPU
+        # devices carve a pipe=2 mesh (on a TPU slice the same legs run
+        # on real chips and SWEEP_CPU_DEVICES is ignored by the backend).
+        # The bubble win itself needs silicon; what this proves anywhere
+        # is schedule parity at equal config, the plan-delta column, and
+        # the offload split-step cost (PCIe legs on hardware, host
+        # round-trip on CPU).
+        PP = {"SWEEP_RECIPE": "pp", "SWEEP_PP": "2",
+              "SWEEP_CPU_DEVICES": "2", "SWEEP_TINY": "1"}
+        grid = [
+            (4, "xla", False, "fused", {**PP, "PP_SCHEDULE": "carry"}),
+            (4, "xla", False, "fused", {**PP, "PP_SCHEDULE": "1f1b"}),
+            (4, "xla", False, "fused", {**PP, "PP_SCHEDULE": "1f1b",
+                                        "OFFLOAD": "on"}),
+            (8, "xla", True, "fused", {**PP, "PP_SCHEDULE": "carry"}),
+            (8, "xla", True, "fused", {**PP, "PP_SCHEDULE": "1f1b"}),
+            (8, "xla", True, "fused", {**PP, "PP_SCHEDULE": "1f1b",
+                                       "PP_VPP": "2"}),
+            (8, "xla", True, "fused", {**PP, "PP_SCHEDULE": "1f1b",
+                                       "OFFLOAD": "on"}),
         ]
     elif args.variants == "ladder":
         # the 350M-1.5B rungs (BASELINE.json): batch/remat per the static

@@ -160,6 +160,45 @@ def test_hbm_planner_moe_plan_memory_uses_expert_axis():
     assert p2.breakdown_gb["params"] < wrong["params"]
 
 
+def test_hbm_planner_prices_pipe_and_tp_axes():
+    """Round 23: the 7B rung prices pp/tp honestly — each pipeline stage
+    holds n_layer/pipe of the block params (plus the embedding on the
+    worst stage), TP column/row-splits the matmul weights — and
+    plan_memory threads the RESOLVED pipe/model axes through, so
+    `memplan --recipe pp --pp-size 8` stops pricing 6.7B params
+    unsharded on every chip."""
+    cfg = PRESETS["gpt2_7b"]()
+    n = memplan.param_count(cfg)
+    emb = cfg.vocab_size * cfg.n_embd
+
+    _, b1 = memplan.estimate_peak_gb(cfg, "pp", 1, "block", dp=2,
+                                     n_params=n)
+    _, b8 = memplan.estimate_peak_gb(cfg, "pp", 1, "block", dp=2,
+                                     n_params=n, pipe=8)
+    expect = ((n - emb) / 8 + emb) * 4 / 2 ** 30
+    np.testing.assert_allclose(b8["params"], expect, rtol=0.01)
+    assert b8["grads"] < b1["grads"]  # stage accumulators shrink too
+    assert b8["acts"] == b1["acts"]   # 1F1B in-flight depth cancels layers
+
+    # plan_memory resolves pipe from TrainConfig.pp_size (mesh.resolve_plan)
+    tc = TrainConfig(total_batch_size=2 ** 19, parallelism="pp", pp_size=8)
+    plan = memplan.plan_memory(cfg, tc, n_devices=16, hbm_gb=16.0,
+                               offload=True)
+    assert plan.fits  # the pod-rung pp row of scripts/train_pod.sh
+    np.testing.assert_allclose(plan.breakdown_gb["params"], expect,
+                               rtol=0.01)
+
+    # fsdp_tp at the real tp axis: matmul weights divide by dp*tp
+    tc_tp = TrainConfig(total_batch_size=2 ** 19, parallelism="fsdp_tp",
+                        tp_size=4)
+    p_tp = memplan.plan_memory(cfg, tc_tp, n_devices=16, hbm_gb=16.0,
+                               offload=True)
+    assert p_tp.fits
+    expect_tp = ((n - emb) / 4 + emb) * 4 / 4 / 2 ** 30  # /tp then /dp
+    np.testing.assert_allclose(p_tp.breakdown_gb["params"], expect_tp,
+                               rtol=0.01)
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("preset,recipe", [("gpt2_350m", "zero2")])
 def test_ladder_350m_two_steps_cpu_mesh(preset, recipe):

@@ -1,0 +1,246 @@
+"""ZeRO-Offload tests (ISSUE 19): the split host-update step must be a
+pure re-placement of the in-HBM AdamW step — params AND moments bitwise
+identical after N steps on the same backend — plus the gate resolution
+(OFFLOAD knob > TrainConfig.offload > memplan auto), the host sharding
+tree checkpoint restore uses, interrupt/resume parity through the loop,
+and the supervisor's prewarm gate (the offload step is not one
+AOT-serializable program).
+
+The full 2-process supervisor gang-restart with offload lives in
+scripts/fault_inject_train.py (CI smoke leg), mirroring the
+test_elastic.py split."""
+
+import os
+import signal
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_pytorch_tpu.config import LLMConfig, TrainConfig
+from distributed_pytorch_tpu.train import checkpoint as ckpt
+from distributed_pytorch_tpu.train import memplan
+from distributed_pytorch_tpu.train import offload
+from distributed_pytorch_tpu.train import supervisor as sup
+from distributed_pytorch_tpu.train.loop import train
+from distributed_pytorch_tpu.train.state import TrainState, create_train_state
+from distributed_pytorch_tpu.train.step import make_train_step
+
+TINY = dict(vocab_size=128, block_size=32, n_embd=32, n_head=4,
+            n_kv_heads=2, n_layer=2, up_dim=64)
+
+
+def _tc(**kw):
+    base = dict(dataset="synthetic", data_dir="bench_data",
+                total_batch_size=2 * 2 * 32, batch_size=2,
+                max_iters=5, parallelism="single", eval=False,
+                log_interval=100, save_stats=False, learning_rate=1e-3,
+                warmup_steps=2)
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+@pytest.fixture()
+def in_tmp(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    return tmp_path
+
+
+def _fake_batch(mc, accum, B, seed=0):
+    rng = np.random.default_rng(seed)
+    starts = rng.integers(0, mc.vocab_size, size=(accum, B, 1))
+    seq = (starts + np.arange(mc.block_size + 1)) % mc.vocab_size
+    import jax.numpy as jnp
+    return (jnp.asarray(seq[..., :-1], jnp.int32),
+            jnp.asarray(seq[..., 1:], jnp.int32))
+
+
+def _tree_bytes(tree):
+    return [np.asarray(l).tobytes() for l in jax.tree_util.tree_leaves(tree)]
+
+
+# ---------------------------------------------------------------------------
+# Bit-parity: offload vs in-HBM AdamW.
+# ---------------------------------------------------------------------------
+
+def test_offload_bitwise_parity_with_in_hbm_adamw():
+    """3 steps, same batches: params, moments AND per-step loss must be
+    byte-identical between the split host-update step and the fused
+    in-HBM step — offload is a re-placement, not an approximation."""
+    mc = LLMConfig(**TINY)
+    tc = TrainConfig(total_batch_size=4 * 32, batch_size=2, max_iters=10,
+                     warmup_steps=2, learning_rate=1e-2,
+                     parallelism="single")
+    model_a, tx_a, state_a, _ = create_train_state(mc, tc, None)
+    model_b, tx_b, state_b, _ = create_train_state(mc, tc, None)
+    assert _tree_bytes(state_a.params) == _tree_bytes(state_b.params), \
+        "create_train_state init must be deterministic for this A/B"
+    step_hbm = make_train_step(model_a, tx_a, mc, tc, None, None)
+    step_off = make_train_step(model_b, tx_b, mc, tc, None, None,
+                               offload=True)
+    assert getattr(step_off, "offload", False), \
+        "offload=True must dispatch to the split step"
+    for i in range(3):
+        x, y = _fake_batch(mc, 2, 2, seed=i)
+        state_a, ma = step_hbm(state_a, x, y)
+        state_b, mb = step_off(state_b, x, y)
+        assert np.asarray(ma["loss"]).tobytes() == \
+            np.asarray(mb["loss"]).tobytes(), f"loss diverged at step {i}"
+    assert _tree_bytes(state_a.params) == _tree_bytes(state_b.params), \
+        "params diverged after 3 steps"
+    assert _tree_bytes(state_a.opt_state) == _tree_bytes(state_b.opt_state), \
+        "optimizer moments diverged after 3 steps"
+    assert int(jax.device_get(state_b.step)) == 3
+
+
+def test_offload_reseeds_host_cache_on_replayed_state():
+    """Replaying the SAME state (a restore / supervisor rejoin shape)
+    must produce the same result as the first pass: the host master
+    cache is keyed by the step counter and re-seeds on discontinuity."""
+    mc = LLMConfig(**TINY)
+    tc = TrainConfig(total_batch_size=4 * 32, batch_size=2, max_iters=10,
+                     warmup_steps=2, learning_rate=1e-2,
+                     parallelism="single")
+    model, tx, state0, _ = create_train_state(mc, tc, None)
+    step_off = make_train_step(model, tx, mc, tc, None, None, offload=True)
+    keep = jax.tree_util.tree_map(np.array, state0.params)
+    x, y = _fake_batch(mc, 2, 2, seed=11)
+    s1, _ = step_off(state0, x, y)
+    first = _tree_bytes(s1.params)
+    replay = TrainState(
+        step=np.zeros((), np.int32),
+        params=jax.tree_util.tree_map(np.array, keep),
+        opt_state=tx.init(jax.tree_util.tree_map(np.array, keep)),
+        moe_state=state0.moe_state)
+    s2, _ = step_off(replay, x, y)
+    assert _tree_bytes(s2.params) == first
+
+
+# ---------------------------------------------------------------------------
+# Gate resolution: OFFLOAD knob > TrainConfig.offload > memplan auto.
+# ---------------------------------------------------------------------------
+
+def test_resolve_offload_knob_overrides_config(monkeypatch):
+    mc = LLMConfig(**TINY)
+    monkeypatch.setenv("OFFLOAD", "on")
+    assert offload.resolve_offload(mc, _tc(offload="off")) is True
+    monkeypatch.setenv("OFFLOAD", "off")
+    assert offload.resolve_offload(mc, _tc(offload="on")) is False
+
+
+def test_resolve_offload_config_modes(monkeypatch):
+    monkeypatch.delenv("OFFLOAD", raising=False)
+    mc = LLMConfig(**TINY)
+    assert offload.resolve_offload(mc, _tc(offload="on")) is True
+    assert offload.resolve_offload(mc, _tc(offload="off")) is False
+
+
+def test_resolve_offload_is_single_controller_only(monkeypatch):
+    """A multi-process gang cannot offload: no process addresses the
+    whole grads/opt trees and the host clip would see local shards only.
+    'on' must fail loudly at spin-up; 'auto' resolves to in-HBM."""
+    monkeypatch.delenv("OFFLOAD", raising=False)
+    mc = LLMConfig(**TINY)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    assert offload.resolve_offload(mc, _tc(offload="auto")) is False
+    with pytest.raises(ValueError, match="single-controller"):
+        offload.resolve_offload(mc, _tc(offload="on"))
+    assert offload.resolve_offload(mc, _tc(offload="off")) is False
+
+
+def test_resolve_offload_auto_is_a_memplan_decision(monkeypatch):
+    """Auto turns on iff the in-HBM plan busts the budget AND the
+    offload plan fits under it — probed by squeezing hbm_gb between the
+    two analytic peaks."""
+    monkeypatch.delenv("OFFLOAD", raising=False)
+    mc = LLMConfig(**TINY)
+    tc = _tc(offload="auto")
+    base, _ = memplan.predicted_train_peak_gb(mc, tc, None)
+    off, _ = memplan.predicted_train_peak_gb(mc, tc, None, offload=True)
+    assert off < base  # moments out of the plan
+    mid = (base + off) / 2
+    assert offload.resolve_offload(mc, tc, None, hbm_gb=mid) is True
+    # a budget both plans fit: stay in-HBM (no behavior cliff)
+    assert offload.resolve_offload(mc, tc, None, hbm_gb=base * 2) is False
+    # a budget neither fits: offload would not save the run — stay off
+    assert offload.resolve_offload(mc, tc, None, hbm_gb=off / 2) is False
+
+
+# ---------------------------------------------------------------------------
+# Host sharding tree (checkpoint restore placement).
+# ---------------------------------------------------------------------------
+
+def test_host_state_sharding_repoints_only_opt_state():
+    marker = object()
+    tree = TrainState(step=marker, params={"w": marker},
+                      opt_state={"mu": 0, "nu": {"a": 1}}, moe_state=marker)
+    host = offload.host_state_sharding(tree)
+    assert host.step is marker and host.params["w"] is marker
+    assert host.moe_state is marker
+    for leaf in jax.tree_util.tree_leaves(host.opt_state):
+        assert isinstance(leaf, jax.sharding.SingleDeviceSharding)
+        assert leaf._device == offload.host_device()
+
+
+# ---------------------------------------------------------------------------
+# Loop integration: interrupt + resume parity with offload on.
+# ---------------------------------------------------------------------------
+
+def test_offload_run_interrupts_and_resumes_bit_identical(in_tmp):
+    """train() with offload='on': SIGINT mid-run checkpoints, the resumed
+    run replays the exact tail of an uninterrupted run — restore lands
+    the moments on the host device and the step re-seeds its master copy
+    from the restored state."""
+    mc = LLMConfig(**TINY)
+    quiet = lambda s: None
+    full = train(mc, _tc(max_iters=8, file_name="offfull", offload="on"),
+                 log=quiet)
+    assert all(np.isfinite(l) for l in full["train_losses"])
+
+    fired = []
+
+    def log_and_interrupt(s):
+        if "iter" in s and not fired:
+            fired.append(1)
+            os.kill(os.getpid(), signal.SIGINT)
+
+    interrupted = train(mc, _tc(max_iters=8, file_name="offrun",
+                                log_interval=1, offload="on"),
+                        log=log_and_interrupt)
+    assert fired
+    assert len(interrupted["train_losses"]) < 9, "SIGINT did not stop"
+    assert ckpt.latest_step_dir(os.path.join("checkpoints", "offrun"))
+
+    resumed = train(mc, _tc(max_iters=8, file_name="offrun", resume=True,
+                            offload="on"), log=quiet)
+    assert resumed["train_losses"] == \
+        full["train_losses"][-len(resumed["train_losses"]):]
+
+
+def test_offload_matches_in_hbm_loop_losses(in_tmp):
+    """The whole loop (data, eval-off, ckpt) produces the same loss
+    curve with the gate on and off — same backend, same numerics."""
+    mc = LLMConfig(**TINY)
+    quiet = lambda s: None
+    on = train(mc, _tc(max_iters=4, file_name="gateon", offload="on"),
+               log=quiet)
+    off = train(mc, _tc(max_iters=4, file_name="gateoff", offload="off"),
+                log=quiet)
+    assert on["train_losses"] == off["train_losses"]
+
+
+# ---------------------------------------------------------------------------
+# Supervisor prewarm gate.
+# ---------------------------------------------------------------------------
+
+def test_supervisor_prewarm_skipped_under_offload(in_tmp, monkeypatch):
+    monkeypatch.setenv("AOT_STORE", "on")
+    monkeypatch.setenv("AOT_STORE_DIR", str(in_tmp / "store"))
+    monkeypatch.delenv("OFFLOAD", raising=False)
+    cfg = sup.SupervisorConfig(hosts=1, train_argv=("-m", "x"),
+                               run_name="pw")
+    s = sup.Supervisor(cfg, log=lambda m: None)
+    assert s._default_prewarm_cmd(1), "store on: prewarm cmd expected"
+    monkeypatch.setenv("OFFLOAD", "on")
+    assert s._default_prewarm_cmd(1) is None, \
+        "offload step is a program pair — nothing to AOT-prewarm"

@@ -292,3 +292,132 @@ def test_pp_moe_eval_apply_without_mutable():
     _, loss_loop, _ = loop_model.apply(variables, idx, tgt)
     _, loss_pp, _ = pp_model.apply(pp_vars, idx, tgt)
     np.testing.assert_allclose(float(loss_pp), float(loss_loop), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Interleaved-1F1B schedule (ISSUE 19): a pure re-scheduling of the carry
+# schedule — bitwise-identical loss, gradients equal up to backward
+# reduction order — with the bubble on the static timeline within 20% of
+# the (S-1)/(vpp*M) Megatron model.
+# ---------------------------------------------------------------------------
+
+from distributed_pytorch_tpu.models.pipeline import (  # noqa: E402
+    _build_1f1b_schedule, resolve_schedule, resolve_vpp, schedule_timeline)
+
+
+def _ab_models(schedule_a="carry", schedule_b="1f1b", m=8):
+    cfg_a = LLMConfig(**KW, pp_stages=2, pp_microbatches=m,
+                      pp_schedule=schedule_a)
+    cfg_b = LLMConfig(**KW, pp_stages=2, pp_microbatches=m,
+                      pp_schedule=schedule_b)
+    idx = jax.random.randint(jax.random.PRNGKey(1), (8, 16), 0, 96)
+    tgt = jax.random.randint(jax.random.PRNGKey(2), (8, 16), 0, 96)
+    params = LLM(cfg_a).init(jax.random.PRNGKey(0), idx, tgt)["params"]
+    return LLM(cfg_a), LLM(cfg_b), params, idx, tgt
+
+
+def _bitwise_equal_trees(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    return all(np.asarray(x).tobytes() == np.asarray(y).tobytes()
+               for x, y in zip(la, lb))
+
+
+def test_1f1b_loss_bitwise_equals_carry():
+    m_carry, m_1f1b, params, idx, tgt = _ab_models()
+    _, loss_c, _ = m_carry.apply({"params": params}, idx, tgt)
+    _, loss_i, _ = m_1f1b.apply({"params": params}, idx, tgt)
+    assert np.asarray(loss_c).tobytes() == np.asarray(loss_i).tobytes(), \
+        f"1f1b loss {float(loss_i)!r} != carry loss {float(loss_c)!r}"
+
+
+def test_1f1b_gradients_match_carry():
+    # the forward is bitwise identical (test above), but the backward
+    # accumulates cotangents through the interleaved hand-backs in a
+    # different reduction order than the carry scan, so shared-parameter
+    # gradients can differ in the last float32 ULPs — assert tight
+    # allclose, not bytes
+    m_carry, m_1f1b, params, idx, tgt = _ab_models()
+    g_c = jax.grad(lambda p: m_carry.apply({"params": p}, idx, tgt)[1])(
+        params)
+    g_i = jax.grad(lambda p: m_1f1b.apply({"params": p}, idx, tgt)[1])(
+        params)
+    for x, y in zip(jax.tree_util.tree_leaves(g_c),
+                    jax.tree_util.tree_leaves(g_i)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                   rtol=1e-5, atol=1e-7)
+
+
+def test_1f1b_is_the_auto_schedule_when_admissible():
+    cfg = LLMConfig(**KW, pp_stages=2)           # 4 % (2*vpp=4) == 0
+    assert resolve_schedule(cfg) == "1f1b"
+    assert resolve_vpp(cfg) == KW["n_layer"] // 2
+
+
+def test_1f1b_auto_falls_back_to_carry_when_inadmissible():
+    """MoE needs the carry schedule (moe_state rides the scan carry), so
+    auto falls back silently and an explicit 1f1b ask fails loudly."""
+    cfg = LLMConfig(**KW, pp_stages=2, moe=True, n_exp=4, n_shared=1,
+                    n_act=2)
+    assert resolve_schedule(cfg) == "carry"
+    with pytest.raises(ValueError):
+        resolve_schedule(dataclasses.replace(cfg, pp_schedule="1f1b"))
+
+
+def test_pp_schedule_knob_overrides_config(monkeypatch):
+    cfg = LLMConfig(**KW, pp_stages=2)
+    monkeypatch.setenv("PP_SCHEDULE", "carry")
+    assert resolve_schedule(cfg) == "carry"
+    monkeypatch.delenv("PP_SCHEDULE")
+    assert resolve_schedule(cfg) == "1f1b"
+
+
+def test_1f1b_schedule_table_covers_every_chunk_microbatch_once():
+    S, vpp, M = 2, 2, 8
+    sched = _build_1f1b_schedule(S, vpp, M)
+    seen = set()
+    for t in range(sched.ticks):
+        for s in range(S):
+            if sched.valid[t, s]:
+                key = (int(sched.q_idx[t, s]), int(sched.mb_idx[t, s]))
+                assert key not in seen, f"duplicate work unit {key}"
+                seen.add(key)
+    assert len(seen) == S * vpp * M              # every (chunk, mb) once
+    assert int(np.sum(sched.inject)) == M        # every mb injected once
+    # each microbatch exits at the tick its LAST chunk runs
+    for m in range(M):
+        t = int(sched.exit_ticks[m])
+        assert sched.valid[t].any()
+
+
+@pytest.mark.parametrize("S,vpp,M", [(2, 2, 8), (4, 2, 8), (2, 4, 4)])
+def test_1f1b_bubble_within_20pct_of_model(S, vpp, M):
+    _, summary = schedule_timeline(S, vpp, M)
+    frac, model = summary["bubble_frac"], summary["bubble_model"]
+    assert abs(frac - model) / model <= 0.20, \
+        f"measured bubble {frac} vs model {model}"
+
+
+def test_1f1b_timeline_rows_interleave_chunks_per_stage():
+    """Per-chunk interleaving on the phase rows: a stage alternates
+    between its vpp virtual chunks across microbatches instead of
+    draining one chunk's microbatches first (the interleave that shrinks
+    warmup to (S-1)/vpp), and the backward half is the exact mirror —
+    it interleaves the same chunks in reverse."""
+    rows, summary = schedule_timeline(2, 2, 8)
+    assert len(rows) == 2 * 2 * 2 * 8            # S * 2 phases * vpp * M
+    stage0 = [r for r in rows if r["stage"] == 0]
+    fwd = [r for r in stage0 if r["phase"] == "fwd"]
+    bwd = [r for r in stage0 if r["phase"] == "bwd"]
+    assert len(fwd) == len(bwd) == 2 * 8         # vpp * M each way
+    # the chunk sequence must SWITCH chunks before finishing either one
+    fwd_chunks = [r["chunk"] for r in fwd]
+    first_switch = next(i for i, q in enumerate(fwd_chunks)
+                        if q != fwd_chunks[0])
+    assert first_switch < 8, "chunk 0 drained all microbatches first"
+    assert fwd_chunks[0] in fwd_chunks[first_switch:], \
+        "never returned to the first chunk: not interleaved"
+    # mirror: bwd rows are the fwd rows reversed, same (chunk, mb) pairs
+    assert [(r["chunk"], r["microbatch"]) for r in bwd] == \
+        [(r["chunk"], r["microbatch"]) for r in reversed(fwd)]

@@ -257,14 +257,15 @@ def test_matrix_green():
     variant, plus the round-17 rung-down re-mesh shapes) validates with
     zero errors, entirely device-free."""
     reports = shardcheck.check_matrix()
-    # 5 configs (4 ladder rungs + moe'd 124m) x (9 recipes x (3 meshes +
-    # 3 rung-down re-mesh cells) + 'single' at 1x1 only)
-    assert len(reports) == 5 * (9 * (3 + 3) + 1)
+    # 6 configs (5 ladder rungs incl. the 7B pod rung + moe'd 124m) x
+    # (9 recipes x (3 meshes + 3 rung-down re-mesh cells) + 'single' at
+    # 1x1 only)
+    assert len(reports) == 6 * (9 * (3 + 3) + 1)
     bad = [r for r in reports if not r.ok]
     assert not bad, "\n\n".join(shardcheck.format_report(r) for r in bad)
     # the elastic cells are present, labeled, and on the shrunken grids
     rung = [r for r in reports if r.variant.startswith("rung_down:")]
-    assert len(rung) == 5 * 9 * 3
+    assert len(rung) == 6 * 9 * 3
     assert {r.variant for r in rung} == {
         "rung_down:2->1", "rung_down:3->2", "rung_down:5->4"}
     for r in rung:
@@ -534,6 +535,83 @@ def test_derived_sp_ring_matches_traced_ppermute_bytes():
     assert ring[0]["bytes"] == derived[0]["bytes"]
 
 
+def test_derived_pipe_1f1b_entry_hand_computed():
+    """pp at 4x2 (pipe=2) under the auto schedule prices the interleaved
+    hand-backs: S=2, vpp=n_layer/S=6, M=auto(min(B, 2S))=4 gives 25 fwd
+    ticks ((M-1 over S rounds) x 12 chunks + drain) — each tick rolls one
+    microbatch's activations, fwd + mirrored bwd."""
+    cfg = PRESETS["gpt2_124m"]()
+    sizes = shardcheck.mesh_sizes_for("pp", (4, 2))
+    tcfg = TrainConfig(parallelism="pp", batch_size=4)
+    entries, findings = commscheck.derived_train_comms(
+        cfg, "pp", sizes, tcfg, accum=2)
+    assert findings == []
+    by = {e["origin"]: e for e in entries}
+    assert "pipe-boundary" not in by
+    e = by["pipe-1f1b"]
+    assert e["family"] == "ppermute" and e["axis"] == "pipe"
+    assert e["vpp"] == 6 and e["n_microbatches"] == 4
+    assert e["ticks"] == 2 * 25
+    act = jnp.dtype(tcfg.compute_dtype).itemsize
+    tok_bytes = 1 * cfg.block_size * cfg.n_embd * act  # b_loc = 4/4
+    assert e["bytes"] == 2 * 25 * 2 * tok_bytes // 4
+
+
+def test_derived_pipe_carry_entry_when_schedule_forced():
+    """pp_schedule='carry' keeps the round-15 boundary pricing: each of
+    the pipe-1 stage boundaries crossed once per direction per
+    micro-step with the full local batch."""
+    import dataclasses
+    cfg = dataclasses.replace(PRESETS["gpt2_124m"](), pp_schedule="carry")
+    sizes = shardcheck.mesh_sizes_for("pp", (4, 2))
+    tcfg = TrainConfig(parallelism="pp", batch_size=4)
+    entries, _ = commscheck.derived_train_comms(
+        cfg, "pp", sizes, tcfg, accum=2)
+    by = {e["origin"]: e for e in entries}
+    assert "pipe-1f1b" not in by
+    act = jnp.dtype(tcfg.compute_dtype).itemsize
+    tok_bytes = 1 * cfg.block_size * cfg.n_embd * act
+    assert by["pipe-boundary"]["bytes"] == 2 * (2 - 1) * 2 * tok_bytes
+
+
+def test_offload_cell_host_update_donation_all_consumed():
+    """The offload audit cell: the traced host optax update must donate
+    params + opt_state with every donated leaf consumed (in-place moment
+    update in host RAM), zero collectives in the host program, and the
+    derived model must carry both PCIe host-transfer legs at 4P bytes."""
+    [r] = commscheck.check_cells(["train/gpt2_124m/fsdp/2x1/offload"])
+    assert r.traced and r.ok, "\n".join(str(f) for f in r.findings)
+    don = r.donation["host_update"]
+    assert don["donated"] > 0
+    assert don["missed"] == [] and don["donated"] == don["consumed"]
+    p4 = commscheck._n_params(PRESETS["gpt2_124m"]()) * 4
+    host = {e["origin"]: e for e in r.derived
+            if e["family"] == "host_transfer"}
+    assert host["offload-grads"]["direction"] == "to_host"
+    assert host["offload-params"]["direction"] == "to_device"
+    assert host["offload-grads"]["bytes"] == p4
+    assert host["offload-params"]["bytes"] == p4
+
+
+def test_7b_preset_validates_on_the_pod_rung_meshes():
+    """The gpt2_7b preset's spec tables stay green on the pod-rung cells
+    it ships on — pp (pipe=2), fsdp, fsdp_tp at 4x2 — and on the
+    supervisor's rung-down re-mesh shape (data 4->2, elastic restart
+    after a host loss)."""
+    cfg = PRESETS["gpt2_7b"]()
+    for recipe in ("pp", "fsdp", "fsdp_tp"):
+        r = shardcheck.check_config(
+            cfg, recipe, shardcheck.mesh_sizes_for(recipe, (4, 2)),
+            preset="gpt2_7b")
+        assert r.ok, shardcheck.format_report(r)
+        if recipe == "pp":
+            assert r.mesh["pipe"] == 2
+    down = shardcheck.check_config(
+        cfg, "pp", shardcheck.mesh_sizes_for("pp", (2, 1)),
+        preset="gpt2_7b", variant="rung_down:4->2")
+    assert down.ok, shardcheck.format_report(down)
+
+
 def test_mutation_replicated_grads_flag_promised_reduce_scatter(
         monkeypatch):
     """Seeded mutation: a grads table that silently replicates under a
@@ -686,6 +764,8 @@ def test_golden_covers_shardcheck_matrix_plus_engine_cells():
     assert "train/gpt2_124m/fsdp/2x1/overlap-accum2" in keys
     decode = {k for k in keys if k.startswith("decode/")}
     assert len(decode) == len(commscheck.DECODE_CELLS)
-    # 5 configs x (9 recipes x 3 meshes + single@1x1) + 2 overlap + 4
-    assert len(keys) == 5 * (9 * 3 + 1) + 2 + 4
+    assert "train/gpt2_124m/fsdp/2x1/offload" in keys
+    # 6 configs x (9 recipes x 3 meshes + single@1x1) + 2 overlap +
+    # 1 offload + 4 engine cells
+    assert len(keys) == 6 * (9 * 3 + 1) + 2 + 1 + 4
     assert golden["errors"] == 0 and golden["ok"]

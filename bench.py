@@ -1389,6 +1389,245 @@ def _serve_router_bench(platform: str) -> dict:
                 "log_dir") if k in out}}
 
 
+def _serve_classes_bench(platform: str) -> dict:
+    """serve_load_classes leg (BENCH_SERVE=1 BENCH_SERVE_CLASSES=1): the
+    control-plane acceptance drill (ISSUE 20). Three in-process replica
+    stacks (scheduler + HTTP server) behind the class/tenant-aware
+    router, driven with a seeded two-tenant, two-class Poisson mix at
+    ~1.5x the probed capacity — one hot tenant offering 60% of the
+    traffic against a per-tenant token bucket set to its fair share.
+    Interactive work must preempt live batch through the lossless
+    requeue path; the leg reports per-class TTFT quantiles, shed causes,
+    preemption counts, and the round's accept booleans:
+    interactive_slo_held / batch_zero_lost / hot_tenant_capped from the
+    live drive, and autoscale_before_knee from a seeded fleet-simulator
+    ramp (sim/fleetsim.py — the SAME Autoscaler object the live router
+    runs; a CPU bench box cannot host a 10x replica ramp)."""
+    import asyncio
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_pytorch_tpu.config import (LLMConfig,
+                                                flagship_gpt124m, knob)
+    from distributed_pytorch_tpu.engine import DecodeEngine
+    from distributed_pytorch_tpu.models.gpt import LLM
+    from distributed_pytorch_tpu.serve.control import TokenBucketFairness
+    from distributed_pytorch_tpu.serve.router import Router
+    from distributed_pytorch_tpu.serve.scheduler import Scheduler, ShedError
+    from distributed_pytorch_tpu.serve.server import ServeApp
+
+    n_dev = len(jax.devices())
+    if platform == "tpu":
+        cfg = flagship_gpt124m()
+        S = int(os.environ.get("BENCH_DECODE_LEN", "1024"))
+        slots = int(os.environ.get("BENCH_DECODE_SLOTS", "16"))
+        dtype = jnp.bfloat16
+        n_req, b_int, b_bat = 180, (16, 48), (64, 128)
+        p_int, p_bat = (16, 96), (64, 384)
+        preset = "gpt2_124m"
+    else:  # CPU proxy: tiny model, small budgets
+        cfg = LLMConfig(vocab_size=1024, block_size=128, n_embd=128,
+                        n_head=4, n_kv_heads=4, attn="mha", n_layer=2,
+                        up_dim=256, non_linearity="swiglu", pos_emb="rope")
+        S, slots, dtype = 128, 2, jnp.float32
+        n_req, b_int, b_bat = 72, (4, 8), (16, 28)
+        p_int, p_bat = (2, 12), (8, 40)
+        preset = "cpu_tiny"
+    n_replicas = int(os.environ.get("BENCH_CLASS_REPLICAS", "3"))
+    model = LLM(cfg, compute_dtype=dtype, attn_impl="auto")
+    rng = jax.random.PRNGKey(0)
+    dummy = jnp.zeros((1, cfg.block_size), jnp.int32)
+    variables = jax.jit(model.init)({"params": rng, "dropout": rng},
+                                    dummy, dummy)
+    npr = np.random.default_rng(0)
+
+    # seeded two-tenant, two-class mix: hot tenant offers 60% of the
+    # traffic, classes split 50/50 within every tenant
+    reqs = []
+    for _ in range(n_req):
+        cls = "interactive" if npr.random() < 0.5 else "batch"
+        p_rng, b_rng = (p_int, b_int) if cls == "interactive" \
+            else (p_bat, b_bat)
+        reqs.append((
+            "hot" if npr.random() < 0.6 else "base", cls,
+            [int(t) for t in npr.integers(
+                0, cfg.vocab_size, int(npr.integers(*p_rng)))],
+            int(npr.integers(*b_rng))))
+
+    engines = [DecodeEngine(model, variables, n_slots=slots, max_len=S,
+                            temperature=0.0, prefix_cache=True)
+               for _ in range(n_replicas)]
+    # warm every prefill bucket + the fused step outside the timed drive
+    buckets = sorted({engines[0].prefill_bucket(len(p))
+                      for _, _, p, _ in reqs})
+    for e in engines:
+        for bucket in buckets:
+            e.admit(list(npr.integers(0, cfg.vocab_size, bucket)), 1)
+        e.admit(reqs[0][2], 2)
+        e.step()
+        while e.n_live:
+            e.step()
+
+    # probe the steady step time at full occupancy -> offered rate
+    eng = engines[0]
+    while eng.free_slots:
+        eng.admit(list(npr.integers(0, cfg.vocab_size, 8)), 10 ** 9)
+    eng.step()
+    t0 = time.perf_counter()
+    for _ in range(8):
+        eng.step()
+    jax.device_get(eng.tok)
+    step_s = (time.perf_counter() - t0) / 8
+    for sid in eng.live_seq_ids:
+        eng.set_budget(sid, 1)
+    while eng.n_live:
+        eng.step()
+
+    mean_budget = (sum(b_int) + sum(b_bat)) / 4
+    load_factor = float(os.environ.get("BENCH_SERVE_LOAD", "1.5"))
+    cap_rps = n_replicas * slots / (mean_budget * step_s)
+    req_rate = cap_rps * load_factor
+    fair_share = cap_rps / 2               # two tenants
+    # The drive's arrival window is a fraction of a second, so a bucket
+    # sized in tokens/s never binds: cap each tenant at half the drive's
+    # request volume instead, with a trickle refill.
+    fair_burst = n_req / 2
+    arrivals = np.cumsum(npr.exponential(1.0 / req_rate, size=n_req))
+    duration_est = float(arrivals[-1])
+
+    async def _drive():
+        scheds = [Scheduler(e, max_queue=4 * slots) for e in engines]
+        apps = [ServeApp(s, port=0) for s in scheds]
+        for s, a in zip(scheds, apps):
+            await s.start()
+            await a.start()
+        router = Router(
+            [f"127.0.0.1:{a.port}" for a in apps],
+            probe_interval_s=0.05, fleet_poll_interval_s=0.5,
+            fairness=TokenBucketFairness(
+                rate_tokens_s=1.0, burst=fair_burst))
+        await router.start()
+
+        per = {"hot": {"offered": 0, "ok": 0, "rate_limited": 0,
+                       "other_shed": 0},
+               "base": {"offered": 0, "ok": 0, "rate_limited": 0,
+                        "other_shed": 0}}
+        batch_admitted, batch_done = 0, 0
+
+        async def one(tenant, cls, prompt, budget):
+            nonlocal batch_admitted, batch_done
+            per[tenant]["offered"] += 1
+            try:
+                out = await router.complete(prompt, budget,
+                                            slo_class=cls, tenant=tenant)
+                if cls == "batch":
+                    # a batch stream that started must END complete —
+                    # preempted-and-resumed included (lossless claim)
+                    batch_admitted += 1
+                    if out["reason"] in ("budget", "eos"):
+                        batch_done += 1
+                per[tenant]["ok"] += 1
+            except ShedError as e:
+                # shed happens BEFORE admission (or as an explicit
+                # rate-limit) — a shed request is not a lost stream
+                if e.cause == "rate_limited":
+                    per[tenant]["rate_limited"] += 1
+                else:
+                    per[tenant]["other_shed"] += 1
+
+        start = time.perf_counter()
+        tasks = []
+        for (tenant, cls, prompt, budget), at in zip(reqs, arrivals):
+            delay = start + at - time.perf_counter()
+            if delay > 0:
+                await asyncio.sleep(delay)
+            tasks.append(asyncio.ensure_future(
+                one(tenant, cls, prompt, budget)))
+        await asyncio.gather(*tasks)
+        dt = time.perf_counter() - start
+        scheds_m = [s.metrics for s in scheds]
+        router_m = router.metrics
+        await router.stop()
+        for s, a in zip(scheds, apps):
+            await a.stop()
+            await s.stop()
+        return router_m, scheds_m, per, batch_admitted, batch_done, dt
+
+    router_m, scheds_m, per, batch_admitted, batch_done, dt = \
+        asyncio.run(_drive())
+
+    slo_s = float(knob("SLO_TTFT_P99_S"))
+    h_int = router_m.ttft_class("interactive")
+    h_bat = router_m.ttft_class("batch")
+    pre_batch = sum(m.class_counts.get("preempted|batch", 0)
+                    for m in scheds_m)
+    pre_inter = sum(m.class_counts.get("preempted|interactive", 0)
+                    for m in scheds_m)
+    hot_admit_rps = per["hot"]["ok"] / dt
+
+    # the autoscaler half of the acceptance: a 10x ramp in the fleet
+    # simulator, driven by the SAME Autoscaler policy object
+    from sim import fleetsim
+    sim_sc = fleetsim.run_report(
+        seed=0, n_replicas=int(os.environ.get("BENCH_SIM_REPLICAS",
+                                              "40")),
+        duration_s=60.0, cost_model="runs/replay/cost_model.json",
+        smoke=True, scenarios=["autoscale"])["scenarios"]["autoscale"]
+
+    accept = {
+        "interactive_slo_held": bool(
+            h_int is not None and h_int.count > 0
+            and h_int.quantile(0.99) <= slo_s),
+        "batch_zero_lost": bool(batch_done == batch_admitted
+                                and pre_batch >= 1),
+        "hot_tenant_capped": bool(
+            per["hot"]["rate_limited"] > 0
+            and per["hot"]["ok"] <= fair_burst + 2
+            and per["base"]["rate_limited"] == 0),
+        "autoscale_before_knee": bool(
+            sim_sc["accept"]["scaled_before_knee"]
+            and sim_sc["accept"]["ci_disjoint_shed_rate"]),
+    }
+    toks = sum(m.counters["tokens_out"] for m in scheds_m)
+    return {"metric": ("serve_classes_tokens_per_sec" if platform == "tpu"
+                       else "cpu_proxy_serve_classes_tokens_per_sec"),
+            "value": round(toks / dt, 1), "unit": "tok/s",
+            "vs_baseline": 0, "accept": accept,
+            "replicas": n_replicas, "n_requests": n_req,
+            "offered_rps": round(req_rate, 2),
+            "capacity_rps": round(cap_rps, 2),
+            "load_factor": load_factor,
+            "fair_share_rps": round(fair_share, 2),
+            "fair_burst_reqs": round(fair_burst, 1),
+            "hot_admitted_rps": round(hot_admit_rps, 2),
+            "tenants": per,
+            "ttft_interactive_p50_ms": (round(h_int.quantile(0.5) * 1e3, 1)
+                                        if h_int and h_int.count else None),
+            "ttft_interactive_p99_ms": (round(h_int.quantile(0.99) * 1e3, 1)
+                                        if h_int and h_int.count else None),
+            "ttft_batch_p99_ms": (round(h_bat.quantile(0.99) * 1e3, 1)
+                                  if h_bat and h_bat.count else None),
+            "preempted_batch": pre_batch,
+            "preempted_interactive": pre_inter,
+            "batch_admitted": batch_admitted, "batch_done": batch_done,
+            "shed_by_cause_class": dict(router_m.shed_class_counts),
+            "sim_autoscale": {
+                "accept": sim_sc["accept"],
+                "t_knee_s": sim_sc["t_knee_s"],
+                "off_shed_rate": sim_sc["arms"]["autoscale_off"]
+                ["capacity_shed_rate"],
+                "on_shed_rate": sim_sc["arms"]["autoscale_on"]
+                ["capacity_shed_rate"],
+                "first_scale_up_t_s": sim_sc["arms"]["autoscale_on"]
+                ["replicas"]["first_scale_up_t_s"]},
+            "probe_step_ms": round(step_s * 1e3, 2),
+            "n_slots": slots, "n_chips": n_dev,
+            "device": jax.devices()[0].device_kind, "preset": preset}
+
+
 def run_bench(platform: str, only_recipe: str | None = None) -> dict:
     """Worker-side measurement. `platform` is 'tpu' or 'cpu'.
 
@@ -1436,6 +1675,8 @@ def run_bench(platform: str, only_recipe: str | None = None) -> dict:
             return _serve_spinup_bench(platform)
         if os.environ.get("BENCH_SERVE_TIER"):
             return _serve_tier_bench(platform)
+        if os.environ.get("BENCH_SERVE_CLASSES"):
+            return _serve_classes_bench(platform)
         return _serve_bench(platform)
 
     if os.environ.get("BENCH_DECODE"):
@@ -1770,7 +2011,15 @@ def main() -> None:
                     # mid-Poisson-drive and replaced; zero-failed /
                     # failover-parity / scaling accept booleans
                     ("serve_load_router",
-                     {"BENCH_SERVE": "1", "BENCH_SERVE_ROUTER": "1"})]:
+                     {"BENCH_SERVE": "1", "BENCH_SERVE_ROUTER": "1"}),
+                    # ISSUE 20: control plane — two-tenant two-class
+                    # Poisson mix at 1.5x capacity through the
+                    # class/tenant-aware router (interactive-slo-held /
+                    # batch-zero-lost / hot-tenant-capped accept
+                    # booleans) + the fleet-sim autoscale ramp
+                    ("serve_load_classes",
+                     {"BENCH_SERVE": "1", "BENCH_SERVE_CLASSES": "1",
+                      "FLASH_DECODE": "on"})]:
                 r = _spawn_worker("tpu", timeout_s=900, extra_env=env)
                 if r:
                     decode_results[name] = r

@@ -256,6 +256,71 @@ register_knob("AOT_STRICT", "off", lambda s: s.strip().lower() or "off",
               "zero cold-start compiles)")
 
 
+# --- control plane: SLO classes, tenant fairness, autoscaler
+# (serve/control.py, sim/fleetsim.py, ISSUE 20) ---
+def _slo_class(s: str) -> str:
+    v = s.strip().lower()
+    if v not in ("interactive", "batch"):
+        raise ValueError(f"expected interactive|batch, got {s!r}")
+    return v
+
+
+register_knob("SLO_CLASS_DEFAULT", "interactive", _slo_class,
+              "SLO class assumed when a request names none "
+              "(X-SLO-Class header / 'slo_class' body field): "
+              "interactive | batch")
+register_knob("SLO_BATCH_RESUME_TIMEOUT_S", "0",
+              lambda s: float(s) if s.strip() else 0.0,
+              "max seconds a preemption-requeued batch request may wait "
+              "for re-admission before an explicit "
+              "ShedError(preempted_batch_timeout); 0 = never (resumed "
+              "batch waits out any interactive burst, lossless)")
+register_knob("TENANT_RATE_TOKENS_S", "0",
+              lambda s: float(s) if s.strip() else 0.0,
+              "per-tenant token-bucket refill rate in requests/s at the "
+              "router (X-Tenant-Id); 0 = fairness off (every tenant "
+              "admitted)")
+register_knob("TENANT_BURST", "32",
+              lambda s: float(s) if s.strip() else 32.0,
+              "per-tenant token-bucket burst capacity (requests) — the "
+              "headroom a tenant may spend above its steady rate")
+register_knob("AUTOSCALE", "off", _onoff,
+              "router autoscaler gate: on | off | auto (auto = on iff a "
+              "replica launcher is configured); watches burn rates + "
+              "occupancy forecasts and drives add/remove_replica")
+register_knob("AUTOSCALE_MIN_REPLICAS", "1", int,
+              "autoscaler floor: never scale the fleet below this")
+register_knob("AUTOSCALE_MAX_REPLICAS", "8", int,
+              "autoscaler ceiling: never scale the fleet above this")
+register_knob("AUTOSCALE_LEAD_S", "15",
+              lambda s: float(s) if s.strip() else 15.0,
+              "scale-up lead time in seconds: the autoscaler acts on the "
+              "demand forecast this far ahead, so a warmed-AOT replica "
+              "(spinup < lead) is serving before the shed knee")
+register_knob("AUTOSCALE_KNEE_OCCUPANCY", "0.85",
+              lambda s: float(s) if s.strip() else 0.85,
+              "occupancy at the shed knee (PERF.md occupancy-vs-shed "
+              "curve): the autoscaler targets capacity that keeps "
+              "forecast occupancy below this")
+register_knob("AUTOSCALE_COOLDOWN_S", "5",
+              lambda s: float(s) if s.strip() else 5.0,
+              "min seconds between autoscaler actions (hysteresis "
+              "against probe-noise flapping)")
+register_knob("SIM_REPLICAS", "100", int,
+              "fleet simulator: initial simulated replica count "
+              "(sim/fleetsim.py)")
+register_knob("SIM_DURATION_S", "120",
+              lambda s: float(s) if s.strip() else 120.0,
+              "fleet simulator: simulated seconds per scenario run")
+register_knob("SIM_SEED", "0", int,
+              "fleet simulator: base RNG seed (arrivals, prompt/budget "
+              "draws, bootstrap resampling)")
+register_knob("SIM_BOOT_S", "2.0",
+              lambda s: float(s) if s.strip() else 2.0,
+              "fleet simulator: spin-up seconds for an autoscaled "
+              "replica (warmed-AOT start->first-token; PERF.md round 22)")
+
+
 ACTIVATIONS = (
     "relu", "gelu", "swish", "mish", "silu", "selu", "celu", "elu",
     "glu", "sigmoid", "lrelu", "tanh", "swiglu",

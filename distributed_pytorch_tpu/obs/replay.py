@@ -476,6 +476,57 @@ def cost_model(a: dict) -> dict:
     return out
 
 
+#: fallback service-time tables for `sim/fleetsim.py` when no
+#: replay-fitted cost_model.json is on disk (CI smoke, fresh clones):
+#: the CPU tiny-model figures from PERF.md round 10 — the sim's A/B
+#: *contrasts* are policy-driven and hold under any plausible table,
+#: but a real fitted model should be preferred whenever present.
+DEFAULT_SIM_TABLES = {
+    "source": "default",
+    "decode_step_ms": 3.0,              # one fused step (ITL, flat in occ)
+    "prefill_a_ms": 2.0,                # step_model intercept
+    "prefill_b_ms_per_token": 0.05,     # step_model slope
+    "boot_s": 2.0,                      # warmed-AOT start -> first token
+}
+
+
+def load_cost_model(path: str) -> dict:
+    """Read a `cost_model.json` written by write_report()."""
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def sim_tables(cm: Optional[dict]) -> dict:
+    """Flatten a cost_model() dict into the scalar service-time tables
+    the fleet simulator consumes — fitted step model (ITL intercept +
+    prefill slope) and the measured spin-up wall. Missing sections fall
+    back to DEFAULT_SIM_TABLES entries, so a partial model (e.g. a
+    decode-only replay) still yields usable tables; `source` records
+    which it was."""
+    out = dict(DEFAULT_SIM_TABLES)
+    if not cm:
+        return out
+    out["source"] = cm.get("run", "cost_model")
+    eng = cm.get("engine") or {}
+    sm = eng.get("step_model") or {}
+    if sm.get("a_ms") is not None:
+        out["prefill_a_ms"] = float(sm["a_ms"])
+    if sm.get("b_ms_per_prefill_token") is not None:
+        out["prefill_b_ms_per_token"] = float(sm["b_ms_per_prefill_token"])
+    dec = eng.get("decode_step_ms") or {}
+    if dec.get("p50"):
+        out["decode_step_ms"] = float(dec["p50"])
+    spin = cm.get("spinup") or {}
+    wall_ms = float(spin.get("load_ms") or 0.0) \
+        + float(spin.get("compile_ms") or 0.0)
+    weights = spin.get("weights_load_ms") or {}
+    if weights.get("p50"):
+        wall_ms += float(weights["p50"])
+    if wall_ms > 0:
+        out["boot_s"] = round(wall_ms / 1e3, 3)
+    return out
+
+
 def write_report(run_dir: str, out_dir: Optional[str] = None) -> dict:
     """Analyze run_dir and write `report.md` + `cost_model.json` into
     out_dir (default: the run dir itself). Returns the analysis plus

@@ -139,6 +139,16 @@ class SLOTracker:
             return 0.0
         return (d_bad / d_total) / target.budget_fraction
 
+    def worst_burn(self, name: Optional[str] = None) -> float:
+        """Max burn rate across windows — and across targets when `name`
+        is None. The autoscaler's reactive backstop (serve/control.py):
+        any objective burning faster than its budget refills (> 1.0) is
+        scale-up pressure regardless of which window caught it."""
+        names = [name] if name is not None else list(self.targets)
+        return max((self.burn_rate(n, w)
+                    for n in names for w in self.windows_s),
+                   default=0.0)
+
     def budget_remaining(self, name: str) -> float:
         """1 - consumed fraction of the budget since process start
         (cumulative counters start at zero, so no baseline snapshot is

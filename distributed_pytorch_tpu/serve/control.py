@@ -1,0 +1,390 @@
+"""Control-plane policies: SLO classes, tenant fairness, autoscaling.
+
+The fleet tier built by rounds 13-22 (router + failover, /metrics/fleet
+federation, SRE burn rates, replay-fitted cost models, the AOT program
+store) supplies mechanisms; this module is the POLICY layer on top —
+three decisions, each a small object with no I/O:
+
+* **SLO classes** (`ClassPolicy`): every request is `interactive` or
+  `batch`. Admission orders the queue interactive-first (FCFS within a
+  class), and under slot pressure live batch work is *voluntarily
+  preempted* through the engine's existing lossless preempt/requeue
+  path — the victim's generated-so-far tokens become its resume prompt,
+  the retained radix/host-tier prefix makes re-admission a cache hit,
+  and the stream continues. Batch absorbs latency, never loss.
+* **Tenant fairness** (`TokenBucketFairness`): a per-tenant token
+  bucket at the router edge. A tenant saturating the fleet spends its
+  burst and then sheds with cause `rate_limited`, while every other
+  tenant's SLO is untouched — per-tenant isolation without per-tenant
+  queues.
+* **Autoscaling** (`Autoscaler`): a pure `decide()` over `FleetSample`
+  observations (occupancy, queue depth, burn rate, booting count). It
+  forecasts demand `lead_s` ahead from a windowed slope and targets the
+  capacity that keeps forecast occupancy below the shed knee of
+  PERF.md's occupancy-vs-shed curve — scaling up BEFORE the knee, which
+  the warmed-AOT replica store (round 22) makes affordable: spin-up is
+  deserialize-and-serve, well inside the lead window.
+
+Every class takes an injected clock (`now_fn`) and consumes plain
+numbers, so the SAME objects run in the live router process and inside
+`sim/fleetsim.py`'s discrete-event clock — sim results are evidence
+about the deployed policy, not about a fork of it. Stdlib-only.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import socket
+import subprocess
+import sys
+import time
+from typing import Callable, Optional
+
+from distributed_pytorch_tpu.config import knob
+
+#: the closed set of SLO classes; admission order is list order.
+SLO_CLASSES = ("interactive", "batch")
+
+
+def normalize_class(value: Optional[str],
+                    default: Optional[str] = None) -> str:
+    """Map a request's class field/header to a member of SLO_CLASSES.
+    None/empty falls back to `default` (or the SLO_CLASS_DEFAULT knob);
+    an unknown name raises ValueError so a typo is a 400, not a silent
+    misclassification."""
+    if not value:
+        return default if default else knob("SLO_CLASS_DEFAULT")
+    v = str(value).strip().lower()
+    if v not in SLO_CLASSES:
+        raise ValueError(f"unknown SLO class {value!r} "
+                         f"(expected one of {SLO_CLASSES})")
+    return v
+
+
+# ----------------------------------------------------------------------
+# per-tenant token-bucket fairness
+# ----------------------------------------------------------------------
+
+class TokenBucketFairness:
+    """Per-tenant token buckets: `admit(tenant)` spends one token and
+    answers whether the request may proceed. Buckets refill at
+    `rate_tokens_s` and cap at `burst`, so a tenant may burst `burst`
+    requests and then sustain exactly the configured rate; everyone
+    else's buckets are untouched. rate <= 0 disables fairness (always
+    admit) — the off leg of the sim A/B.
+
+    A tenant's first request creates its bucket FULL, so fairness never
+    penalizes a cold tenant. `snapshot()` reports per-tenant admitted/
+    rejected counts for the metrics page.
+    """
+
+    def __init__(self, rate_tokens_s: Optional[float] = None,
+                 burst: Optional[float] = None,
+                 now_fn: Callable[[], float] = time.monotonic):
+        self.rate = (rate_tokens_s if rate_tokens_s is not None
+                     else knob("TENANT_RATE_TOKENS_S"))
+        self.burst = max(1.0, burst if burst is not None
+                         else knob("TENANT_BURST"))
+        self._now = now_fn
+        # tenant -> [level, last_refill_t, admitted, rejected]
+        self._buckets: dict[str, list] = {}
+
+    @property
+    def enabled(self) -> bool:
+        return self.rate > 0
+
+    def admit(self, tenant: Optional[str], cost: float = 1.0) -> bool:
+        """Spend `cost` from the tenant's bucket; False = shed with
+        cause rate_limited. Anonymous traffic (tenant None/empty) is
+        never rate-limited — fairness isolates *identified* tenants
+        from each other."""
+        if not self.enabled or not tenant:
+            return True
+        now = self._now()
+        b = self._buckets.get(tenant)
+        if b is None:
+            b = self._buckets[tenant] = [self.burst, now, 0, 0]
+        level, last, _, _ = b
+        level = min(self.burst, level + (now - last) * self.rate)
+        b[1] = now
+        if level >= cost:
+            b[0] = level - cost
+            b[2] += 1
+            return True
+        b[0] = level
+        b[3] += 1
+        return False
+
+    def snapshot(self) -> dict:
+        """Per-tenant ledger: current level, lifetime admitted/rejected."""
+        return {t: {"level": round(b[0], 3), "admitted": b[2],
+                    "rejected": b[3]}
+                for t, b in sorted(self._buckets.items())}
+
+
+# ----------------------------------------------------------------------
+# SLO-class admission + preemption policy
+# ----------------------------------------------------------------------
+
+class ClassPolicy:
+    """Pure ordering/selection rules for class-aware scheduling. The
+    scheduler owns the queue and the engine; this object only answers
+    *where* a request goes and *who* gets preempted, so the identical
+    rules run against the simulator's queues."""
+
+    #: numeric admission rank (lower admits first)
+    RANK = {c: i for i, c in enumerate(SLO_CLASSES)}
+
+    @classmethod
+    def insert_index(cls, queue, slo_class: str,
+                     resumed: bool = False) -> int:
+        """Index at which a request of `slo_class` enters `queue` (a
+        sequence of objects with .slo_class / .resumed). The invariant
+        maintained: interactive section first, then batch, FCFS within
+        a section — except resumed requests, which go to the FRONT of
+        their class section (they are mid-stream; within the resumed
+        group original order is preserved by inserting after earlier
+        resumes). A preempted batch request therefore re-queues AHEAD
+        of queued batch work but BEHIND every waiting interactive
+        request — it can never immediately re-steal the slot it was
+        evicted from."""
+        rank = cls.RANK[slo_class]
+        i = 0
+        for i, req in enumerate(queue):
+            r_rank = cls.RANK.get(getattr(req, "slo_class", SLO_CLASSES[0]),
+                                  0)
+            if r_rank > rank:
+                return i
+            if r_rank == rank and resumed \
+                    and not getattr(req, "resumed", False):
+                return i
+        return len(queue)
+
+    @staticmethod
+    def queued_interactive(queue) -> int:
+        return sum(1 for r in queue
+                   if getattr(r, "slo_class", "interactive")
+                   == "interactive")
+
+    @staticmethod
+    def preempt_count(n_interactive_queued: int, n_free_slots: int,
+                      n_live_batch: int) -> int:
+        """How many live batch requests to evict so every queued
+        interactive request can reach a slot: the interactive backlog
+        not covered by free slots, capped by the evictable population.
+        Zero whenever free slots cover the backlog — preemption is the
+        pressure valve, never the steady state."""
+        return max(0, min(n_live_batch,
+                          n_interactive_queued - n_free_slots))
+
+    @staticmethod
+    def pick_victims(live_batch, k: int) -> list:
+        """Choose `k` victims among live batch requests: most recently
+        admitted first (ties: fewest tokens served), so the work
+        discarded-and-resumed is the work with the least decode
+        progress sunk into its slot."""
+        ranked = sorted(
+            live_batch,
+            key=lambda r: (-(getattr(r, "admitted_at", 0.0) or 0.0),
+                           getattr(r, "served", 0)))
+        return ranked[:max(0, k)]
+
+
+# ----------------------------------------------------------------------
+# autoscaler
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass
+class FleetSample:
+    """One observation of the fleet, from the router's health-probe
+    gauges (live) or the simulator's state (sim).
+
+    occupancy: mean live-slot fraction across serving replicas.
+    queue_depth: summed replica queue depths (router-visible backlog).
+    n_replicas: serving replicas; n_booting: spawned, not yet healthy.
+    worst_burn: max SLO burn rate across targets/windows (0 = quiet).
+    shed_recent: sheds observed since the previous sample.
+    """
+    t: float
+    n_replicas: int
+    n_booting: int = 0
+    occupancy: float = 0.0
+    queue_depth: int = 0
+    worst_burn: float = 0.0
+    shed_recent: int = 0
+
+
+class Autoscaler:
+    """Forecast-driven proportional scaler: keep forecast occupancy
+    below the shed knee, with burn rate as the reactive backstop.
+
+    decide(sample) -> signed replica delta (0 = hold). The caller
+    actuates (spawn/drain); the policy only looks at numbers:
+
+    * demand, in busy-replica equivalents, is `occupancy * n_replicas`
+      plus the queued backlog converted at one replica-slotful per
+      replica — the quantity that is invariant under scaling.
+    * a windowed linear slope extrapolates demand `lead_s` ahead;
+      capacity is sized so forecast demand / capacity < knee. Scaling
+      on the FORECAST is what turns the AOT store's fast spin-up into
+      shed prevented: replicas are serving when the ramp arrives, not
+      `boot_s` after the knee.
+    * scale-up: any of (forecast occupancy past the knee) / (burn rate
+      > 1) / (sheds observed) triggers; booting replicas count toward
+      capacity so one ramp does not double-provision.
+    * scale-down: only when forecast occupancy at the SMALLER fleet
+      stays under `down_frac * knee` (hysteresis), one replica at a
+      time, and never within `cooldown_s` of the last action or below
+      `min_replicas`.
+    """
+
+    def __init__(self, *, min_replicas: Optional[int] = None,
+                 max_replicas: Optional[int] = None,
+                 lead_s: Optional[float] = None,
+                 knee_occupancy: Optional[float] = None,
+                 cooldown_s: Optional[float] = None,
+                 down_frac: float = 0.6,
+                 slope_window_s: float = 30.0,
+                 now_fn: Callable[[], float] = time.monotonic):
+        self.min_replicas = (min_replicas if min_replicas is not None
+                             else knob("AUTOSCALE_MIN_REPLICAS"))
+        self.max_replicas = (max_replicas if max_replicas is not None
+                             else knob("AUTOSCALE_MAX_REPLICAS"))
+        self.lead_s = lead_s if lead_s is not None \
+            else knob("AUTOSCALE_LEAD_S")
+        self.knee = (knee_occupancy if knee_occupancy is not None
+                     else knob("AUTOSCALE_KNEE_OCCUPANCY"))
+        self.cooldown_s = (cooldown_s if cooldown_s is not None
+                           else knob("AUTOSCALE_COOLDOWN_S"))
+        self.down_frac = down_frac
+        self.slope_window_s = slope_window_s
+        self._now = now_fn
+        self._demand: list[tuple[float, float]] = []   # (t, demand)
+        self._last_action_t = -float("inf")
+        self.decisions = 0
+        self.scaled_up = 0
+        self.scaled_down = 0
+
+    # -- internals -----------------------------------------------------
+
+    def _forecast_demand(self, t: float) -> float:
+        """Least-squares slope over the retained window, extrapolated
+        lead_s ahead (never below the newest observation — a dip must
+        not forecast negative demand during a ramp pause)."""
+        pts = self._demand
+        cur = pts[-1][1]
+        if len(pts) < 3:
+            return cur
+        t0 = pts[0][0]
+        xs = [p[0] - t0 for p in pts]
+        ys = [p[1] for p in pts]
+        n = len(pts)
+        mx = sum(xs) / n
+        my = sum(ys) / n
+        den = sum((x - mx) ** 2 for x in xs)
+        if den <= 1e-12:
+            return cur
+        slope = sum((x - mx) * (y - my) for x, y in zip(xs, ys)) / den
+        return max(cur, cur + slope * self.lead_s)
+
+    # -- API -----------------------------------------------------------
+
+    def decide(self, s: FleetSample) -> int:
+        """Consume one fleet sample, return the replica delta to
+        actuate (positive = spawn, negative = drain+remove, 0 = hold)."""
+        self.decisions += 1
+        n = max(1, s.n_replicas)
+        # demand in busy-replica equivalents; queued work converted at
+        # one backlog unit per replica-slotful already keeps the units
+        # fleet-size invariant (queue_depth is summed over replicas)
+        demand = s.occupancy * n + (s.queue_depth / max(1, n)) \
+            * min(1.0, s.occupancy + 0.5)
+        self._demand.append((s.t, demand))
+        horizon = s.t - self.slope_window_s
+        while len(self._demand) > 2 and self._demand[0][0] < horizon:
+            self._demand.pop(0)
+
+        capacity = s.n_replicas + s.n_booting
+        forecast = self._forecast_demand(s.t)
+        if s.t - self._last_action_t < self.cooldown_s:
+            return 0
+        # scale up: forecast occupancy past the knee, the SLO budget
+        # burning faster than it refills, or sheds already happening
+        pressure = (forecast / max(1, capacity) > self.knee
+                    or s.worst_burn > 1.0
+                    or s.shed_recent > 0)
+        if pressure and capacity < self.max_replicas:
+            target = min(self.max_replicas,
+                         max(capacity + 1,
+                             int(forecast / self.knee) + 1))
+            delta = target - capacity
+            self._last_action_t = s.t
+            self.scaled_up += delta
+            return delta
+        # scale down: one at a time, only when the smaller fleet still
+        # clears the hysteresis band and nothing is queued or booting
+        if (capacity > self.min_replicas and s.n_booting == 0
+                and s.queue_depth == 0 and s.shed_recent == 0
+                and s.worst_burn <= 1.0
+                and forecast / max(1, capacity - 1)
+                < self.knee * self.down_frac):
+            self._last_action_t = s.t
+            self.scaled_down += 1
+            return -1
+        return 0
+
+
+# ----------------------------------------------------------------------
+# live actuator: warmed-AOT replica subprocesses
+# ----------------------------------------------------------------------
+
+class ReplicaLauncher:
+    """Spawn/terminate replica serve processes for the live autoscaler.
+
+    `cmd_template` is a shell-free argv template; every occurrence of
+    the literal `{port}` is substituted with a freshly bound ephemeral
+    port. The intended template points at the serve CLI with an AOT
+    store so spin-up is deserialize-and-serve (round 22), e.g.::
+
+        python -m distributed_pytorch_tpu.serve --cpu --demo \\
+            --port {port} --aot-store runs/aot_store
+
+    The launcher does NOT health-check: the router's failure detector
+    already owns replica state, and a spawned replica joins the pool
+    through the same init->healthy probe path as any other."""
+
+    def __init__(self, cmd_template: list[str], host: str = "127.0.0.1"):
+        assert any("{port}" in a for a in cmd_template), \
+            "cmd_template must contain a {port} placeholder"
+        self.cmd_template = list(cmd_template)
+        self.host = host
+        self.procs: dict[str, subprocess.Popen] = {}   # addr -> proc
+
+    @staticmethod
+    def free_port(host: str = "127.0.0.1") -> int:
+        with socket.socket() as s:
+            s.bind((host, 0))
+            return s.getsockname()[1]
+
+    def spawn(self) -> str:
+        port = self.free_port(self.host)
+        argv = [a.replace("{port}", str(port)) for a in self.cmd_template]
+        proc = subprocess.Popen(argv, stdout=sys.stderr, stderr=sys.stderr)
+        addr = f"{self.host}:{port}"
+        self.procs[addr] = proc
+        return addr
+
+    def terminate(self, addr: str, timeout_s: float = 5.0) -> bool:
+        proc = self.procs.pop(addr, None)
+        if proc is None:
+            return False
+        proc.terminate()
+        try:
+            proc.wait(timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        return True
+
+    def shutdown(self) -> None:
+        for addr in list(self.procs):
+            self.terminate(addr)

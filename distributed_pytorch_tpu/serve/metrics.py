@@ -290,6 +290,50 @@ def render_fleet(snapshots: dict) -> str:
                         "serve_fleet_requests_total"
                         f'{_labels({"event": k, "replica": r})} '
                         f'{snap["counters"][k]}')
+    class_hist_names: list[str] = []
+    class_names: list[str] = []
+    for _, snap in reps:
+        for cls, hists in snap.get("histograms_by_class", {}).items():
+            if cls not in class_names:
+                class_names.append(cls)
+            for hn in hists:
+                if hn not in class_hist_names:
+                    class_hist_names.append(hn)
+    for hn in sorted(class_hist_names):
+        first = True
+        for cls in sorted(class_names):
+            per = [(r, snap["histograms_by_class"][cls][hn])
+                   for r, snap in reps
+                   if hn in snap.get("histograms_by_class", {})
+                   .get(cls, {})]
+            if not per:
+                continue
+            lines += render_hist_snap(
+                merge_histograms([s for _, s in per]),
+                labels={"class": cls}, header=first)
+            first = False
+    shed_keys: list[tuple[str, str]] = []
+    for _, snap in reps:
+        for k in snap.get("shed_by_cause_class", {}):
+            cause, _, cls = k.partition("|")
+            if (cause, cls) not in shed_keys:
+                shed_keys.append((cause, cls))
+    if shed_keys:
+        lines += ["# HELP serve_fleet_shed_total sheds by cause and SLO "
+                  "class, summed across replicas (and per replica)",
+                  "# TYPE serve_fleet_shed_total counter"]
+        for cause, cls in sorted(shed_keys):
+            k = f"{cause}|{cls}"
+            tot = sum(int(snap.get("shed_by_cause_class", {}).get(k, 0))
+                      for _, snap in reps)
+            lines.append("serve_fleet_shed_total"
+                         f'{_labels({"cause": cause, "class": cls})} {tot}')
+            for r, snap in reps:
+                if k in snap.get("shed_by_cause_class", {}):
+                    lines.append(
+                        "serve_fleet_shed_total"
+                        f'{_labels({"cause": cause, "class": cls, "replica": r})} '
+                        f'{snap["shed_by_cause_class"][k]}')
     occ_n = sum(int(s.get("occ_n", 0)) for _, s in reps)
     occ_sum = sum(float(s.get("occ_sum", 0.0)) for _, s in reps)
     lines += ["# HELP serve_fleet_slot_occupancy_mean mean live-slot "
@@ -391,6 +435,15 @@ class ServeMetrics:
         self.counters = dict.fromkeys(self.COUNTERS, 0)
         self.shed_counts: dict[str, int] = {}     # cause -> n
         self.retire_counts: dict[str, int] = {}   # reason -> n
+        # control plane (round 24): the same ledgers split by SLO class.
+        # Keys are "cause|class" / "event|class" flat strings so the
+        # snapshot stays JSON-round-trippable; per-class TTFT histograms
+        # live under their class in `histograms_by_class` — a separate
+        # snapshot key so the fleet page's merge-by-name logic never
+        # conflates a class slice with the all-traffic series.
+        self.shed_class_counts: dict[str, int] = {}
+        self.class_counts: dict[str, int] = {}
+        self._ttft_class: dict[str, Histogram] = {}
         self.build_info: dict[str, str] = {}      # provenance labels
         self.weights_version: Optional[str] = None
         self._occ_sum = 0.0
@@ -400,9 +453,33 @@ class ServeMetrics:
     def inc(self, name: str, n: int = 1) -> None:
         self.counters[name] += n
 
-    def shed(self, cause: str) -> None:
+    def shed(self, cause: str, slo_class: Optional[str] = None) -> None:
         self.counters["shed"] += 1
         self.shed_counts[cause] = self.shed_counts.get(cause, 0) + 1
+        if slo_class:
+            k = f"{cause}|{slo_class}"
+            self.shed_class_counts[k] = self.shed_class_counts.get(k, 0) + 1
+
+    def inc_class(self, event: str, slo_class: str, n: int = 1) -> None:
+        """Per-SLO-class slice of a lifecycle counter (the unsliced
+        counter is still incremented via `inc` by the caller)."""
+        k = f"{event}|{slo_class}"
+        self.class_counts[k] = self.class_counts.get(k, 0) + n
+
+    def observe_ttft_class(self, slo_class: str, v: float) -> None:
+        """Per-class TTFT sample (the all-traffic `ttft` histogram is
+        observed separately by the caller): the class-isolation SLO —
+        interactive p99 held while batch absorbs preemptions — reads
+        from these slices."""
+        h = self._ttft_class.get(slo_class)
+        if h is None:
+            h = self._ttft_class[slo_class] = Histogram(
+                "serve_ttft_seconds",
+                "submit to first streamed token, per SLO class")
+        h.observe(v)
+
+    def ttft_class(self, slo_class: str) -> Optional[Histogram]:
+        return self._ttft_class.get(slo_class)
 
     def retired(self, reason: str) -> None:
         self.retire_counts[reason] = self.retire_counts.get(reason, 0) + 1
@@ -458,8 +535,13 @@ class ServeMetrics:
         return {"kind": "serve",
                 "histograms": {h.name: h.to_dict()
                                for h in self._histograms()},
+                "histograms_by_class": {
+                    cls: {h.name: h.to_dict()}
+                    for cls, h in sorted(self._ttft_class.items())},
                 "counters": dict(self.counters),
                 "shed_by_cause": dict(self.shed_counts),
+                "shed_by_cause_class": dict(self.shed_class_counts),
+                "counters_by_class": dict(self.class_counts),
                 "retired_by_reason": dict(self.retire_counts),
                 "gauges": gauges,
                 "build_info": dict(self.build_info),
@@ -480,6 +562,9 @@ class ServeMetrics:
                 {"version": self.weights_version})
         for h in self._histograms():
             lines += h.render()
+        for cls, h in sorted(self._ttft_class.items()):
+            lines += render_hist_snap(h.to_dict(), labels={"class": cls},
+                                      header=False)
         lines += ["# HELP serve_requests_total request lifecycle counters",
                   "# TYPE serve_requests_total counter"]
         for name in ("submitted", "admitted", "completed", "cancelled",
@@ -517,6 +602,14 @@ class ServeMetrics:
                       f"{name} {self.counters[f'kv_tier_{ev}_blocks']}"]
         for cause, n in sorted(self.shed_counts.items()):
             lines.append(f'serve_shed_total{{cause="{cause}"}} {n}')
+        for k, n in sorted(self.shed_class_counts.items()):
+            cause, _, cls = k.partition("|")
+            lines.append("serve_shed_total"
+                         f'{_labels({"cause": cause, "class": cls})} {n}')
+        for k, n in sorted(self.class_counts.items()):
+            ev, _, cls = k.partition("|")
+            lines.append("serve_requests_total"
+                         f'{_labels({"event": ev, "class": cls})} {n}')
         for reason, n in sorted(self.retire_counts.items()):
             lines.append(f'serve_retired_total{{reason="{reason}"}} {n}')
         lines += ["# HELP serve_tokens_streamed_total tokens fanned out",
@@ -553,6 +646,13 @@ class ServeMetrics:
             out["weights_version"] = self.weights_version
         if self.shed_counts:
             out["shed_by_cause"] = dict(self.shed_counts)
+        if self.shed_class_counts:
+            out["shed_by_cause_class"] = dict(self.shed_class_counts)
+        if self.class_counts:
+            out["counters_by_class"] = dict(self.class_counts)
+        if self._ttft_class:
+            out["ttft_by_class"] = {cls: h.summary() for cls, h
+                                    in sorted(self._ttft_class.items())}
         if self.retire_counts:
             out["retired_by_reason"] = dict(self.retire_counts)
         if self._gauges:
@@ -580,9 +680,14 @@ class RouterMetrics:
     #: radix-digest prefix affinity (cache-aware routing) rather than
     #: pure least-loaded — the fleet-wide prefix reuse the tier bench
     #: leg's 2-replica drive pins.
+    #: 'preempt_redispatches' counts batch streams re-driven after a
+    #: voluntary class preemption timed out downstream — exempt from the
+    #: shared retry_budget (they are policy, not failures), so they get
+    #: their own ledger entry.
     COUNTERS = ("submitted", "dispatched", "completed", "shed",
                 "tokens_out", "failovers", "retries", "replica_down",
-                "replica_up", "replayed_tokens", "sticky_hits")
+                "replica_up", "replayed_tokens", "sticky_hits",
+                "preempt_redispatches")
 
     def __init__(self):
         self._gauges: dict[str, tuple[Callable[[], float], str]] = {}
@@ -599,13 +704,38 @@ class RouterMetrics:
         self.shed_counts: dict[str, int] = {}        # cause -> n
         self.dispatch_counts: dict[str, int] = {}    # replica -> n
         self.build_info: dict[str, str] = {}         # provenance labels
+        # control plane: sheds sliced by class ("cause|class") and by
+        # tenant ("cause|tenant" — rate_limited is the interesting one),
+        # plus per-class client-edge TTFT.
+        self.shed_class_counts: dict[str, int] = {}
+        self.shed_tenant_counts: dict[str, int] = {}
+        self._ttft_class: dict[str, Histogram] = {}
 
     def inc(self, name: str, n: int = 1) -> None:
         self.counters[name] += n
 
-    def shed(self, cause: str) -> None:
+    def shed(self, cause: str, slo_class: Optional[str] = None,
+             tenant: Optional[str] = None) -> None:
         self.counters["shed"] += 1
         self.shed_counts[cause] = self.shed_counts.get(cause, 0) + 1
+        if slo_class:
+            k = f"{cause}|{slo_class}"
+            self.shed_class_counts[k] = self.shed_class_counts.get(k, 0) + 1
+        if tenant:
+            k = f"{cause}|{tenant}"
+            self.shed_tenant_counts[k] = \
+                self.shed_tenant_counts.get(k, 0) + 1
+
+    def observe_ttft_class(self, slo_class: str, v: float) -> None:
+        h = self._ttft_class.get(slo_class)
+        if h is None:
+            h = self._ttft_class[slo_class] = Histogram(
+                "router_ttft_seconds",
+                "submit to first token through the router, per SLO class")
+        h.observe(v)
+
+    def ttft_class(self, slo_class: str) -> Optional[Histogram]:
+        return self._ttft_class.get(slo_class)
 
     def dispatched(self, replica: str) -> None:
         self.counters["dispatched"] += 1
@@ -633,8 +763,13 @@ class RouterMetrics:
         return {"kind": "router",
                 "histograms": {h.name: h.to_dict()
                                for h in (self.ttft, self.itl, self.e2e)},
+                "histograms_by_class": {
+                    cls: {h.name: h.to_dict()}
+                    for cls, h in sorted(self._ttft_class.items())},
                 "counters": dict(self.counters),
                 "shed_by_cause": dict(self.shed_counts),
+                "shed_by_cause_class": dict(self.shed_class_counts),
+                "shed_by_cause_tenant": dict(self.shed_tenant_counts),
                 "dispatch_by_replica": dict(self.dispatch_counts),
                 "gauges": gauges,
                 "build_info": dict(self.build_info)}
@@ -646,14 +781,25 @@ class RouterMetrics:
             self.build_info)
         for h in (self.ttft, self.itl, self.e2e):
             lines += h.render()
+        for cls, h in sorted(self._ttft_class.items()):
+            lines += render_hist_snap(h.to_dict(), labels={"class": cls},
+                                      header=False)
         lines += ["# HELP router_requests_total router request lifecycle",
                   "# TYPE router_requests_total counter"]
         for name in ("submitted", "dispatched", "completed", "shed",
-                     "failovers", "retries"):
+                     "failovers", "retries", "preempt_redispatches"):
             lines.append(f'router_requests_total{{event="{name}"}} '
                          f'{self.counters[name]}')
         for cause, n in sorted(self.shed_counts.items()):
             lines.append(f'router_shed_total{{cause="{cause}"}} {n}')
+        for k, n in sorted(self.shed_class_counts.items()):
+            cause, _, cls = k.partition("|")
+            lines.append("router_shed_total"
+                         f'{_labels({"cause": cause, "class": cls})} {n}')
+        for k, n in sorted(self.shed_tenant_counts.items()):
+            cause, _, tenant = k.partition("|")
+            lines.append("router_shed_total"
+                         f'{_labels({"cause": cause, "tenant": tenant})} {n}')
         for rep, n in sorted(self.dispatch_counts.items()):
             lines.append(f'router_dispatch_total{{replica="{rep}"}} {n}')
         lines += ["# HELP dispatch_sticky_hits_total dispatches routed "
@@ -695,6 +841,13 @@ class RouterMetrics:
             out["build_info"] = dict(self.build_info)
         if self.shed_counts:
             out["shed_by_cause"] = dict(self.shed_counts)
+        if self.shed_class_counts:
+            out["shed_by_cause_class"] = dict(self.shed_class_counts)
+        if self.shed_tenant_counts:
+            out["shed_by_cause_tenant"] = dict(self.shed_tenant_counts)
+        if self._ttft_class:
+            out["ttft_by_class"] = {cls: h.summary() for cls, h
+                                    in sorted(self._ttft_class.items())}
         if self.dispatch_counts:
             out["dispatch_by_replica"] = dict(self.dispatch_counts)
         if self._gauges:

@@ -60,7 +60,11 @@ from distributed_pytorch_tpu.obs import trace as obs_trace
 from distributed_pytorch_tpu.obs.slo import SLOTracker
 from distributed_pytorch_tpu.ops.block_pool import (ROOT_DIGEST,
                                                     _child_digest)
-from distributed_pytorch_tpu.serve.metrics import (RouterMetrics,
+from distributed_pytorch_tpu.serve.control import (Autoscaler, FleetSample,
+                                                   ReplicaLauncher,
+                                                   TokenBucketFairness,
+                                                   normalize_class)
+from distributed_pytorch_tpu.serve.metrics import (RouterMetrics, _labels,
                                                    render_fleet)
 from distributed_pytorch_tpu.serve.scheduler import ShedError
 from distributed_pytorch_tpu.serve.server import (_json_response,
@@ -179,7 +183,11 @@ class Router:
                  stream_idle_timeout_s: Optional[float] = None,
                  metrics: Optional[RouterMetrics] = None,
                  fleet_poll_interval_s: Optional[float] = None,
-                 slo: Optional[SLOTracker] = None):
+                 slo: Optional[SLOTracker] = None,
+                 fairness: Optional[TokenBucketFairness] = None,
+                 autoscaler: Optional[Autoscaler] = None,
+                 launcher: Optional[ReplicaLauncher] = None,
+                 autoscale_interval_s: float = 1.0):
         self.replicas: dict[str, Replica] = {}
         for addr in replicas:
             rep = Replica(addr)
@@ -215,7 +223,20 @@ class Router:
         # only here — the replica never observes it), availability folds
         # in the federated replica-side 'failed' counters
         self.slo = slo if slo is not None else SLOTracker()
+        # control plane (serve/control.py): per-tenant token buckets at
+        # the edge (knob-backed; rate 0 = off), and the forecast-driven
+        # autoscaler whose actuator spawns warmed-AOT replica processes
+        # through `launcher`. The SAME policy objects run inside
+        # sim/fleetsim.py — here they just get the wall clock.
+        self.fairness = (fairness if fairness is not None
+                         else TokenBucketFairness())
+        self.autoscaler = autoscaler
+        self.launcher = launcher
+        self.autoscale_interval_s = autoscale_interval_s
+        self._shed_seen = 0            # autoscale tick's shed-delta base
+        self._retiring: set[str] = set()   # scale-down drains in flight
         self._probe_task: Optional[asyncio.Task] = None
+        self._autoscale_task: Optional[asyncio.Task] = None
         self._rr = 0                   # round-robin tiebreak cursor
 
     @property
@@ -232,15 +253,22 @@ class Router:
         await self.probe_all()
         self._probe_task = asyncio.create_task(self._probe_loop(),
                                                name="router-prober")
+        if self.autoscaler is not None:
+            self._autoscale_task = asyncio.create_task(
+                self._autoscale_loop(), name="router-autoscaler")
 
     async def stop(self) -> None:
-        if self._probe_task is not None:
-            self._probe_task.cancel()
-            try:
-                await self._probe_task
-            except asyncio.CancelledError:
-                pass
-            self._probe_task = None
+        for attr in ("_probe_task", "_autoscale_task"):
+            task = getattr(self, attr)
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+                setattr(self, attr, None)
+        if self.launcher is not None:
+            self.launcher.shutdown()
 
     def add_replica(self, addr: str) -> Replica:
         """Register a replica at runtime (state 'init' until its first
@@ -393,7 +421,9 @@ class Router:
 
     async def stream(self, prompt: list, max_tokens: int, *,
                      deadline_s: Optional[float] = None,
-                     trace_id: Optional[str] = None) \
+                     trace_id: Optional[str] = None,
+                     slo_class: Optional[str] = None,
+                     tenant: Optional[str] = None) \
             -> AsyncIterator[dict]:
         """The router's request path: yields `{"token": id}` events and
         one final `{"done": ..., "reason": ..., "n_tokens": ...,
@@ -415,9 +445,24 @@ class Router:
         t_submit = time.perf_counter()
         tid = trace_id or obs_trace.new_trace_id()
         tr = self.tracer
+        slo_class = normalize_class(slo_class)
+        # tenant fairness gate, BEFORE any replica work: a hot tenant
+        # past its token bucket sheds here with the distinct cause
+        # rate_limited (HTTP 429) while every other tenant's bucket —
+        # and the replicas' queues — stay untouched
+        if not self.fairness.admit(tenant):
+            self.metrics.inc("submitted")
+            self.metrics.shed("rate_limited", slo_class, tenant)
+            tr.event("router.rate_limited", tid, cat="router",
+                     tenant=tenant)
+            raise ShedError(
+                "rate_limited",
+                f"tenant {tenant!r} over its token bucket "
+                f"({self.fairness.rate}/s, burst {self.fairness.burst:g})")
         self.metrics.inc("submitted")
         got: list[int] = []
         attempts = 0
+        preempt_redispatches = 0
         tried: set[str] = set()
         last_tok_at: Optional[float] = None
         last_cause, last_msg = "no_replica", "no healthy replica"
@@ -442,7 +487,7 @@ class Router:
             try:
                 rep = self.pick(exclude=tried, digests=_digests)
             except NoReplica:
-                self.metrics.shed(last_cause)
+                self.metrics.shed(last_cause, slo_class, tenant)
                 _end_request(f"shed:{last_cause}")
                 raise ShedError(last_cause, last_msg) from None
             self.metrics.dispatched(rep.name)
@@ -460,7 +505,7 @@ class Router:
                 # user-visible loss (same exemption the scheduler gives
                 # preemption resumes)
                 deadline_s=deadline_s if not got else None,
-                trace_id=tid)
+                trace_id=tid, slo_class=slo_class)
             try:
                 async for ev in inner:
                     if "token" in ev:
@@ -468,6 +513,8 @@ class Router:
                         now = time.perf_counter()
                         if len(got) == 1:
                             self.metrics.ttft.observe(now - t_submit)
+                            self.metrics.observe_ttft_class(
+                                slo_class, now - t_submit)
                         elif last_tok_at is not None:
                             self.metrics.itl.observe(now - last_tok_at)
                         last_tok_at = now
@@ -509,14 +556,33 @@ class Router:
                     # the request's own SLO expired in a replica queue —
                     # that is the client's explicit backpressure signal,
                     # not a replica fault; propagate, don't retry
-                    self.metrics.shed("deadline")
+                    self.metrics.shed("deadline", slo_class, tenant)
                     _end_request("shed:deadline")
                     raise ShedError("deadline", str(e)) from None
                 last_cause, last_msg = e.cause, str(e)
+                if e.cause == "preempted_batch_timeout" \
+                        and slo_class == "batch" \
+                        and preempt_redispatches \
+                        <= self.retry_budget * 4 + 8:
+                    # class-aware retry exemption: this batch stream was
+                    # evicted by POLICY (preempted for interactive work,
+                    # then timed out waiting to resume) — not a replica
+                    # fault, so it must not burn the shared retry_budget
+                    # that guards real failovers. Re-drive it (prompt +
+                    # tokens-so-far, same lossless offset as a failover)
+                    # on whatever replica the next pick likes; its own
+                    # generous cap only backstops a pathological loop.
+                    preempt_redispatches += 1
+                    self.metrics.inc("preempt_redispatches")
+                    tr.event("router.preempt_redispatch", tid,
+                             cat="router", from_replica=rep.name,
+                             tokens=len(got))
+                    continue
                 attempts += 1
                 tried.add(rep.name)
                 if attempts > self.retry_budget:
-                    self.metrics.shed("retries_exhausted")
+                    self.metrics.shed("retries_exhausted", slo_class,
+                                      tenant)
                     _end_request("shed:retries_exhausted")
                     raise ShedError(
                         "retries_exhausted",
@@ -540,7 +606,8 @@ class Router:
                 attempts += 1
                 tried.add(rep.name)
                 if attempts > self.retry_budget:
-                    self.metrics.shed("retries_exhausted")
+                    self.metrics.shed("retries_exhausted", slo_class,
+                                      tenant)
                     _end_request("shed:retries_exhausted")
                     raise ShedError(
                         "retries_exhausted",
@@ -580,14 +647,17 @@ class Router:
 
     async def complete(self, prompt: list, max_tokens: int, *,
                        deadline_s: Optional[float] = None,
-                       trace_id: Optional[str] = None) -> dict:
+                       trace_id: Optional[str] = None,
+                       slo_class: Optional[str] = None,
+                       tenant: Optional[str] = None) -> dict:
         """Non-streaming collect: returns {tokens, reason, failovers,
         trace_id, spans}."""
         tokens: list[int] = []
         done: dict = {}
         async for ev in self.stream(prompt, max_tokens,
                                     deadline_s=deadline_s,
-                                    trace_id=trace_id):
+                                    trace_id=trace_id,
+                                    slo_class=slo_class, tenant=tenant):
             if "token" in ev:
                 tokens.append(ev["token"])
             else:
@@ -636,7 +706,8 @@ class Router:
     async def _stream_once(self, rep: Replica, prompt: list,
                            max_tokens: int,
                            deadline_s: Optional[float],
-                           trace_id: Optional[str] = None) \
+                           trace_id: Optional[str] = None,
+                           slo_class: Optional[str] = None) \
             -> AsyncIterator[dict]:
         """One dispatch: POST the completion to `rep` (propagating the
         trace id via `X-Trace-Id`, so the replica's spans land on the
@@ -648,6 +719,8 @@ class Router:
                       "stream": True}
         if deadline_s is not None:
             body["deadline_s"] = deadline_s
+        if slo_class is not None:
+            body["slo_class"] = slo_class
         reader, writer = await self._connect(rep, self.connect_timeout_s)
         try:
             payload = json.dumps(body).encode()
@@ -718,8 +791,114 @@ class Router:
 
     def render_fleet(self) -> str:
         """The `/metrics/fleet` page: fleet-summed histograms/counters
-        plus per-replica labeled series (serve/metrics.render_fleet)."""
-        return render_fleet(self.fleet_snapshots())
+        plus per-replica labeled series (serve/metrics.render_fleet),
+        with the router-edge control-plane ledgers appended — per-class
+        and per-tenant shed counts only exist here (the replicas never
+        see a rate-limited request), so the fleet page carries them."""
+        lines = [render_fleet(self.fleet_snapshots()).rstrip("\n")]
+        if self.metrics.shed_class_counts or self.metrics.shed_tenant_counts:
+            lines += ["# HELP router_shed_total router-edge sheds by "
+                      "cause and SLO class / tenant",
+                      "# TYPE router_shed_total counter"]
+            for k, n in sorted(self.metrics.shed_class_counts.items()):
+                cause, _, cls = k.partition("|")
+                lines.append("router_shed_total"
+                             f'{_labels({"cause": cause, "class": cls})} '
+                             f"{n}")
+            for k, n in sorted(self.metrics.shed_tenant_counts.items()):
+                cause, _, tenant = k.partition("|")
+                lines.append(
+                    "router_shed_total"
+                    f'{_labels({"cause": cause, "tenant": tenant})} {n}')
+        return "\n".join(lines) + "\n"
+
+    # ------------------------------------------------------------------
+    # autoscaler (control plane)
+    # ------------------------------------------------------------------
+
+    def fleet_sample(self) -> FleetSample:
+        """One `FleetSample` for the autoscaler, from the state the
+        health probes already maintain — occupancy and queue depth from
+        the healthz gauges, booting = replicas registered but not yet
+        through their first healthy probe, burn rate from the SLO
+        tracker, and the shed delta since the last sample (capacity
+        sheds only — rate_limited is a fairness decision, not demand)."""
+        serving = [r for r in self.replicas.values()
+                   if r.state == "healthy"]
+        occ = (sum(r.live_slots / r.n_slots
+                   for r in serving if r.n_slots)
+               / max(1, len(serving))) if serving else 0.0
+        shed_total = self.metrics.counters["shed"] - sum(
+            n for k, n in self.metrics.shed_counts.items()
+            if k == "rate_limited")
+        delta, self._shed_seen = (max(0, shed_total - self._shed_seen),
+                                  shed_total)
+        return FleetSample(
+            t=time.perf_counter(),
+            n_replicas=len(serving),
+            n_booting=sum(1 for r in self.replicas.values()
+                          if r.state == "init"),
+            occupancy=occ,
+            queue_depth=sum(r.queue_depth for r in serving),
+            worst_burn=self.slo.worst_burn(),
+            shed_recent=delta)
+
+    async def _autoscale_loop(self) -> None:
+        while True:
+            await asyncio.sleep(self.autoscale_interval_s)
+            try:
+                await self._autoscale_tick()
+            except Exception:          # pragma: no cover — the scaler
+                pass                   # must never die to a stray error
+
+    async def _autoscale_tick(self) -> None:
+        await self._reap_retiring()
+        delta = self.autoscaler.decide(self.fleet_sample())
+        if delta > 0 and self.launcher is not None:
+            for _ in range(delta):
+                addr = self.launcher.spawn()
+                self.add_replica(addr)
+                self.tracer.event("router.scale_up", None, cat="router",
+                                  replica=addr)
+        elif delta < 0:
+            await self._scale_down_one()
+
+    async def _scale_down_one(self) -> None:
+        """Drain the idlest launcher-owned replica (never a seed replica
+        — the operator placed those); it leaves dispatch immediately and
+        is reaped (removed + terminated) once its healthz reports
+        drained, so scale-down loses zero in-flight streams."""
+        owned = [r for r in self.replicas.values()
+                 if r.state == "healthy" and r.name not in self._retiring
+                 and self.launcher is not None
+                 and r.name in self.launcher.procs]
+        if not owned:
+            return
+        victim = min(owned, key=lambda r: r.load)
+        try:
+            await self.drain(victim.name)
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            return
+        self._retiring.add(victim.name)
+
+    async def _reap_retiring(self) -> None:
+        for name in list(self._retiring):
+            rep = self.replicas.get(name)
+            if rep is None:
+                self._retiring.discard(name)
+                continue
+            try:
+                _, body = await self._http_json(
+                    rep, "GET", "/healthz", timeout=self.probe_timeout_s)
+                drained = bool(body.get("drained"))
+            except (ConnectionError, OSError, asyncio.TimeoutError,
+                    asyncio.IncompleteReadError, ValueError):
+                drained = True         # already gone: reap the corpse
+            if drained:
+                self._retiring.discard(name)
+                self.remove_replica(name)
+                if self.launcher is not None:
+                    self.launcher.terminate(name)
 
     def _slo_counts(self) -> dict:
         """Cumulative (good, total) per SLO target. Latency objectives
@@ -775,12 +954,17 @@ class RouterApp:
 
     def __init__(self, router: Router, *, host: str = "127.0.0.1",
                  port: int = 8000, default_max_tokens: int = 64,
-                 request_timeout_s: float = 30.0):
+                 request_timeout_s: float = 30.0,
+                 default_slo_class: Optional[str] = None):
         self.router = router
         self.host = host
         self.port = port
         self.default_max_tokens = default_max_tokens
         self.request_timeout_s = request_timeout_s
+        # requests that carry neither a body field nor an X-SLO-Class
+        # header get this class (CLI --slo-class-default; falls through
+        # to the SLO_CLASS_DEFAULT knob when None)
+        self.default_slo_class = default_slo_class
         self._server: Optional[asyncio.base_events.Server] = None
 
     async def start(self) -> None:
@@ -973,32 +1157,50 @@ class RouterApp:
             return
         deadline = body.get("deadline_s")
         deadline = float(deadline) if deadline is not None else None
+        # control plane: SLO class (body field, X-SLO-Class header, CLI
+        # default, knob — in that order) and tenant (X-Tenant-Id header
+        # or body field) for the router-edge fairness bucket
+        try:
+            slo_class = normalize_class(
+                body.get("slo_class") or headers.get("x-slo-class"),
+                default=self.default_slo_class)
+        except ValueError as e:
+            writer.write(_json_response(400, {"error": str(e)}))
+            return
+        tenant = headers.get("x-tenant-id") or body.get("tenant") or None
         if bool(body.get("stream", True)):
             await self._stream_sse(reader, writer, prompt, max_tokens,
-                                   deadline, trace_id)
+                                   deadline, trace_id,
+                                   slo_class=slo_class, tenant=tenant)
             return
         try:
             out = await self.router.complete(prompt, max_tokens,
                                              deadline_s=deadline,
-                                             trace_id=trace_id)
+                                             trace_id=trace_id,
+                                             slo_class=slo_class,
+                                             tenant=tenant)
         except ShedError as e:
             writer.write(_json_response(
-                429 if e.cause in ("queue_full", "retries_exhausted")
+                429 if e.cause in ("queue_full", "retries_exhausted",
+                                   "rate_limited")
                 else 503, {"error": str(e), "cause": e.cause}))
             return
         writer.write(_json_response(200, out))
 
     async def _stream_sse(self, reader, writer, prompt, max_tokens,
-                          deadline, trace_id=None) -> None:
+                          deadline, trace_id=None, *,
+                          slo_class=None, tenant=None) -> None:
         agen = self.router.stream(prompt, max_tokens, deadline_s=deadline,
-                                  trace_id=trace_id)
+                                  trace_id=trace_id,
+                                  slo_class=slo_class, tenant=tenant)
         # shed BEFORE the first event maps to an HTTP status (the client
         # has seen nothing yet); after that it becomes an SSE error event
         try:
             first = await agen.__anext__()
         except ShedError as e:
             writer.write(_json_response(
-                429 if e.cause in ("queue_full", "retries_exhausted")
+                429 if e.cause in ("queue_full", "retries_exhausted",
+                                   "rate_limited")
                 else 503, {"error": str(e), "cause": e.cause}))
             return
         except StopAsyncIteration:     # pragma: no cover — can't happen
@@ -1080,25 +1282,81 @@ def build_args(argv=None):
                    help="min seconds between /metrics.json federation "
                         "pulls per replica (default: the "
                         "FLEET_POLL_INTERVAL_S knob)")
+    # control plane (serve/control.py)
+    p.add_argument("--slo-class-default", type=str, default=None,
+                   choices=("interactive", "batch"),
+                   help="class for requests that send neither a "
+                        "'slo_class' body field nor an X-SLO-Class "
+                        "header (default: the SLO_CLASS_DEFAULT knob)")
+    p.add_argument("--tenant-rate", type=float, default=None,
+                   help="per-tenant token-bucket refill rate, requests/s "
+                        "(default: the TENANT_RATE_TOKENS_S knob; "
+                        "0 = fairness off)")
+    p.add_argument("--tenant-burst", type=float, default=None,
+                   help="per-tenant bucket capacity (default: the "
+                        "TENANT_BURST knob)")
+    p.add_argument("--autoscale", type=str, default=None,
+                   choices=("on", "off", "auto"),
+                   help="run the forecast-driven autoscaler (default: "
+                        "the AUTOSCALE knob; 'auto' = on iff "
+                        "--replica-cmd is given)")
+    p.add_argument("--replica-cmd", type=str, default=None,
+                   help="argv template for spawning replicas on scale-up "
+                        "(shlex-split; must contain a {port} "
+                        "placeholder), e.g. \"python -m "
+                        "distributed_pytorch_tpu.serve --cpu --demo "
+                        "--port {port} --aot-store runs/aot_store\"")
+    p.add_argument("--autoscale-min", type=int, default=None,
+                   help="floor replicas (default AUTOSCALE_MIN_REPLICAS)")
+    p.add_argument("--autoscale-max", type=int, default=None,
+                   help="ceiling replicas (default AUTOSCALE_MAX_REPLICAS)")
+    p.add_argument("--autoscale-lead-s", type=float, default=None,
+                   help="demand-forecast horizon (default "
+                        "AUTOSCALE_LEAD_S); cover a replica's boot time")
     return p.parse_args(argv)
 
 
+def build_control_plane(args):
+    """Resolve the CLI's control-plane flags (knob-backed defaults) into
+    the fairness / autoscaler / launcher objects Router takes — shared
+    by _amain and tests so both construct the policies identically."""
+    import shlex
+    fairness = TokenBucketFairness(rate_tokens_s=args.tenant_rate,
+                                   burst=args.tenant_burst)
+    launcher = (ReplicaLauncher(shlex.split(args.replica_cmd))
+                if args.replica_cmd else None)
+    mode = args.autoscale if args.autoscale is not None \
+        else knob("AUTOSCALE")
+    enabled = mode == "on" or (mode == "auto" and launcher is not None)
+    autoscaler = Autoscaler(min_replicas=args.autoscale_min,
+                            max_replicas=args.autoscale_max,
+                            lead_s=args.autoscale_lead_s) \
+        if enabled else None
+    return fairness, autoscaler, launcher
+
+
 async def _amain(args) -> None:
+    fairness, autoscaler, launcher = build_control_plane(args)
     router = Router([a for a in args.replicas.split(",") if a.strip()],
                     probe_interval_s=args.probe_interval_s,
                     fail_threshold=args.fail_threshold,
                     backoff_base_s=args.backoff_base_s,
                     backoff_cap_s=args.backoff_cap_s,
                     retry_budget=args.retry_budget,
-                    fleet_poll_interval_s=args.fleet_poll_interval_s)
+                    fleet_poll_interval_s=args.fleet_poll_interval_s,
+                    fairness=fairness, autoscaler=autoscaler,
+                    launcher=launcher)
     app = RouterApp(router, host=args.host, port=args.port,
-                    default_max_tokens=args.max_tokens_default)
+                    default_max_tokens=args.max_tokens_default,
+                    default_slo_class=args.slo_class_default)
     await router.start()
     await app.start()
     up = sum(r.dispatchable for r in router.replicas.values())
     print(f"routing on http://{args.host}:{app.port} over "
           f"{len(router.replicas)} replicas ({up} healthy), "
-          f"retry_budget={args.retry_budget}")
+          f"retry_budget={args.retry_budget}, "
+          f"fairness={'on' if fairness.enabled else 'off'}, "
+          f"autoscale={'on' if autoscaler is not None else 'off'}")
     try:
         await app.serve_forever()
     except (KeyboardInterrupt, asyncio.CancelledError):

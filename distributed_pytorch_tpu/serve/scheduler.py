@@ -70,17 +70,20 @@ import dataclasses
 import time
 from typing import Optional
 
+from distributed_pytorch_tpu.config import knob
 from distributed_pytorch_tpu.engine.decode import Retired
 from distributed_pytorch_tpu.obs import trace as obs_trace
 from distributed_pytorch_tpu.ops.block_pool import NoFreeBlocks
+from distributed_pytorch_tpu.serve.control import ClassPolicy, normalize_class
 from distributed_pytorch_tpu.serve.metrics import (ServeMetrics,
                                                    engine_build_info)
 
 
 class ShedError(RuntimeError):
     """Admission control rejected/evicted the request (queue_full |
-    deadline | shutdown | draining | engine_error). Surfaces as HTTP
-    429/503 — never a hang."""
+    deadline | shutdown | draining | engine_error | rate_limited |
+    preempted_batch_timeout). Surfaces as HTTP 429/503 — never a
+    hang."""
 
     def __init__(self, cause: str, msg: str):
         super().__init__(msg)
@@ -130,6 +133,13 @@ class _Request:
     first_tok_at: Optional[float] = None
     adm_prefix: int = 0
     adm_prefilled: int = 0
+    # SLO class (serve/control.py): admission orders interactive ahead
+    # of batch, and under slot pressure live batch work is voluntarily
+    # preempted through the lossless requeue path. preempted_at stamps
+    # the LAST preemption — the clock the optional
+    # preempted_batch_timeout shed runs against.
+    slo_class: str = "interactive"
+    preempted_at: Optional[float] = None
 
 
 class RequestHandle:
@@ -217,11 +227,18 @@ class Scheduler:
 
     def __init__(self, engine, *, max_queue: int = 128,
                  metrics: Optional[ServeMetrics] = None,
-                 default_deadline_s: Optional[float] = None):
+                 default_deadline_s: Optional[float] = None,
+                 batch_resume_timeout_s: Optional[float] = None):
         self.engine = engine
         self.max_queue = max_queue
         self.metrics = metrics if metrics is not None else ServeMetrics()
         self.default_deadline_s = default_deadline_s
+        # 0 = never: a preempted batch stream waits out any interactive
+        # burst and resumes losslessly; > 0 bounds that wait, shedding
+        # with the distinct cause the router exempts from retry_budget
+        self.batch_resume_timeout_s = (
+            batch_resume_timeout_s if batch_resume_timeout_s is not None
+            else knob("SLO_BATCH_RESUME_TIMEOUT_S"))
         self._queue: collections.deque[_Request] = collections.deque()
         self._live: dict[int, _Request] = {}       # seq_id -> request
         self._cancel_live: list[_Request] = []     # applied between steps
@@ -341,23 +358,27 @@ class Scheduler:
 
     def submit(self, prompt, max_new_tokens: int, *,
                deadline_s: Optional[float] = None,
-               trace_id: Optional[str] = None) -> RequestHandle:
-        """Enqueue a request (FCFS). Raises `ShedError` immediately when
-        the admission queue is at its bound or the scheduler is stopping —
+               trace_id: Optional[str] = None,
+               slo_class: Optional[str] = None) -> RequestHandle:
+        """Enqueue a request (FCFS within its SLO class; interactive
+        admits ahead of batch). Raises `ShedError` immediately when the
+        admission queue is at its bound or the scheduler is stopping —
         backpressure is explicit, the caller maps it to HTTP 429/503.
         `trace_id` hangs the request's lifecycle spans (queue / prefill /
         decode / retire) on an end-to-end trace (obs/trace.py)."""
+        slo_class = normalize_class(slo_class)
         if self._failed is not None:
             raise ShedError("engine_error", str(self._failed))
         if self._stopping:
             raise ShedError("shutdown", "scheduler is stopping")
         if self._draining:
-            self.metrics.shed("draining")
+            self.metrics.shed("draining", slo_class)
             raise ShedError("draining", "scheduler is draining; no new "
                                         "admissions (live slots retiring)")
         self.metrics.inc("submitted")
+        self.metrics.inc_class("submitted", slo_class)
         if len(self._queue) >= self.max_queue:
-            self.metrics.shed("queue_full")
+            self.metrics.shed("queue_full", slo_class)
             raise ShedError(
                 "queue_full",
                 f"admission queue at bound ({self.max_queue}); retry later")
@@ -367,10 +388,17 @@ class Scheduler:
                        max_new=max_new_tokens, deadline_s=deadline_s,
                        submitted_at=time.perf_counter(), handle=None,
                        orig_prompt_len=len(prompt),
-                       budget_total=max_new_tokens, trace_id=trace_id)
+                       budget_total=max_new_tokens, trace_id=trace_id,
+                       slo_class=slo_class)
         req.handle = RequestHandle(self, req)
         self._pending.add(req.handle)
-        self._queue.append(req)
+        # interactive inserts ahead of the queued batch section; batch
+        # appends — plain FCFS whenever only one class is in play
+        idx = ClassPolicy.insert_index(self._queue, slo_class)
+        if idx >= len(self._queue):
+            self._queue.append(req)
+        else:
+            self._queue.insert(idx, req)
         self._wake.set()
         return req.handle
 
@@ -439,6 +467,8 @@ class Scheduler:
         token is an ITL sample."""
         if req.served == 0:
             self.metrics.ttft.observe(now - req.submitted_at)
+            self.metrics.observe_ttft_class(req.slo_class,
+                                            now - req.submitted_at)
             req.first_tok_at = now
         else:
             self.metrics.itl.observe(now - req.last_tok_at)
@@ -522,17 +552,34 @@ class Scheduler:
         (its tokens are already streaming) and never a preemption-requeued
         one (same reason: the client already holds part of the stream, so
         a shed here would be user-visible loss; the deadline only bounds
-        the wait for the FIRST token)."""
+        the wait for the FIRST token). The one exception is opt-in: with
+        `batch_resume_timeout_s > 0`, a voluntarily preempted batch
+        request that has waited longer than that for re-admission sheds
+        with the distinct cause 'preempted_batch_timeout' — which the
+        router re-drives WITHOUT burning its retry budget (the client
+        still keeps a lossless stream, just via another replica)."""
         keep: collections.deque[_Request] = collections.deque()
         for req in self._queue:
             if not req.resumed and req.deadline_s is not None \
                     and now - req.submitted_at > req.deadline_s:
-                self.metrics.shed("deadline")
+                self.metrics.shed("deadline", req.slo_class)
                 self._trace_terminal(req, now, "shed", cause="deadline")
                 req.handle._push_error(ShedError(
                     "deadline",
                     f"queued {now - req.submitted_at:.3f}s > deadline "
                     f"{req.deadline_s:.3f}s"))
+            elif req.resumed and req.slo_class == "batch" \
+                    and self.batch_resume_timeout_s > 0 \
+                    and req.preempted_at is not None \
+                    and now - req.preempted_at > self.batch_resume_timeout_s:
+                self.metrics.shed("preempted_batch_timeout", req.slo_class)
+                self._trace_terminal(req, now, "shed",
+                                     cause="preempted_batch_timeout")
+                req.handle._push_error(ShedError(
+                    "preempted_batch_timeout",
+                    f"preempted batch request waited "
+                    f"{now - req.preempted_at:.3f}s > "
+                    f"{self.batch_resume_timeout_s:.3f}s for re-admission"))
             else:
                 keep.append(req)
         self._queue = keep
@@ -669,8 +716,47 @@ class Scheduler:
                 self.metrics.inc(f"aot_store_{k}", delta)
                 self._aot_seen[k] = total
 
+    async def _preempt_for_interactive(self, loop) -> None:
+        """Voluntary class preemption: when queued interactive requests
+        outnumber free slots and batch work holds slots, evict just
+        enough live batch streams (most recently admitted first — least
+        decode progress lost) through the engine's lossless cancel ->
+        requeue path. The victim's tokens-so-far become its resume
+        prompt; its retained radix/host-tier prefix makes re-admission a
+        cache hit; it re-queues at the FRONT of the batch section —
+        behind every waiting interactive request, ahead of queued batch
+        work. Batch absorbs latency, never loss."""
+        n_int = sum(1 for r in self._queue
+                    if r.slo_class == "interactive" and not r.cancelled)
+        if not n_int:
+            return
+        live_batch = [r for r in self._live.values()
+                      if r.slo_class == "batch" and not r.cancelled]
+        k = ClassPolicy.preempt_count(n_int, self.engine.n_free,
+                                      len(live_batch))
+        if k <= 0:
+            return
+        victims = ClassPolicy.pick_victims(live_batch, k)
+
+        def _evict():
+            return [self.engine.cancel(r.seq_id) for r in victims]
+
+        rets = await loop.run_in_executor(self._exec, _evict)
+        now = time.perf_counter()
+        for req, ret in zip(victims, rets):
+            self._live.pop(req.seq_id, None)
+            if ret is None:            # retired in the same step: done
+                continue
+            ret.reason = "preempted"   # policy eviction, not abandonment
+            if self._requeue_preempted(req, ret):
+                req.preempted_at = now
+                idx = ClassPolicy.insert_index(self._queue, "batch",
+                                               resumed=True)
+                self._queue.insert(idx, req)
+
     def _finish(self, req: _Request, ret: Retired, now: float) -> None:
         self.metrics.inc("completed")
+        self.metrics.inc_class("completed", req.slo_class)
         self.metrics.retired(ret.reason)
         self.metrics.e2e.observe(now - req.submitted_at)
         # a resumed request's final record reports the caller-visible
@@ -707,6 +793,7 @@ class Scheduler:
         req.resumed = True
         self.metrics.inc("preempted")
         self.metrics.inc("requeued")
+        self.metrics.inc_class("preempted", req.slo_class)
         return True
 
     async def _run(self) -> None:
@@ -718,6 +805,9 @@ class Scheduler:
                 self._shed_expired(now)
                 if self._stopping:
                     break
+                # class preemption BEFORE admission: evicted batch slots
+                # free up for the interactive backlog in this same pass
+                await self._preempt_for_interactive(loop)
                 await self._admit_wave(loop)
                 self._tier_sync()      # admits demote (preempt) + promote
                 self._aot_sync()       # admits can build fresh buckets
@@ -769,13 +859,19 @@ class Scheduler:
                         continue
                     if ret.reason == "preempted":
                         if self._requeue_preempted(req, ret):
+                            req.preempted_at = now
                             requeued.append(req)
                     else:
                         self._finish(req, ret, now)
-                # queue HEAD, original order: a preempted request outranks
-                # everything that arrived after it
-                for req in reversed(requeued):
-                    self._queue.appendleft(req)
+                # front of the request's CLASS section, original order: a
+                # preempted request outranks everything of its class that
+                # arrived after it, but a preempted batch request never
+                # jumps a waiting interactive one
+                for req in requeued:
+                    idx = ClassPolicy.insert_index(self._queue,
+                                                   req.slo_class,
+                                                   resumed=True)
+                    self._queue.insert(idx, req)
                 # one cooperative yield so consumers drain between steps
                 await asyncio.sleep(0)
         except Exception as exc:               # crash guard: error, not hang

@@ -58,6 +58,7 @@ from typing import Optional
 
 from distributed_pytorch_tpu.obs import profile as obs_profile
 from distributed_pytorch_tpu.obs import trace as obs_trace
+from distributed_pytorch_tpu.serve.control import normalize_class
 from distributed_pytorch_tpu.serve.scheduler import (RequestHandle,
                                                      Scheduler, ShedError)
 
@@ -378,15 +379,24 @@ class ServeApp:
             return
         deadline = body.get("deadline_s")
         stream = bool(body.get("stream", True))
+        # SLO class: body field wins, then the X-SLO-Class header (the
+        # router forwards either), then the SLO_CLASS_DEFAULT knob
+        try:
+            slo_class = normalize_class(
+                body.get("slo_class") or headers.get("x-slo-class"))
+        except ValueError as e:
+            writer.write(_json_response(400, {"error": str(e),
+                                              "trace_id": trace_id}))
+            return
 
         try:
             handle = self.scheduler.submit(
                 prompt, max_tokens,
                 deadline_s=float(deadline) if deadline is not None
-                else None, trace_id=trace_id)
+                else None, trace_id=trace_id, slo_class=slo_class)
         except ShedError as e:
             writer.write(_json_response(
-                429 if e.cause == "queue_full" else 503,
+                429 if e.cause in ("queue_full", "rate_limited") else 503,
                 {"error": str(e), "cause": e.cause,
                  "trace_id": trace_id}))
             return

@@ -19,7 +19,12 @@
 #                            cold vs warmed from the AOT program store
 #                            plus the train restart sub-leg
 #                            (warm-faster / hit-rate-1 / greedy-parity
-#                            accept booleans); worst case ~75 min if the tunnel
+#                            accept booleans), and the round-24
+#                            serve_load_classes leg: two-tenant two-class
+#                            control-plane drive — interactive-SLO /
+#                            lossless-batch-preempt / hot-tenant-capped
+#                            accept booleans plus a fleetsim autoscale
+#                            A/B; worst case ~75 min if the tunnel
 #                            goes half-up mid-bench, so the cap is 90 min —
 #                            bench always prints its JSON line if allowed
 #                            to finish)

@@ -1,0 +1,10 @@
+"""Discrete-event fleet simulator for the serving control plane.
+
+`sim.fleetsim` replays Poisson traffic against 100-1000 simulated
+replicas — millions of simulated requests on a 1-core dev box — running
+the SAME policy objects as the live router (serve/control.py's
+TokenBucketFairness / ClassPolicy / Autoscaler and obs/slo.py's
+SLOTracker, all clock-injected), with service times from replay-fitted
+cost_model.json tables (obs/replay.py). Seeded and wall-clock-free: the
+same seed produces byte-identical output, which tier-1 CI gates on.
+"""

@@ -1,0 +1,863 @@
+"""Seeded discrete-event fleet simulator for the serving control plane.
+
+Purpose: prove the control-plane POLICIES (serve/control.py) at a scale
+no CPU test rig can reach — hundreds of simulated replicas, millions of
+simulated requests — before they meet real traffic. The simulator is
+evidence about the deployed policy, not a fork of it:
+
+* the policy objects are the LIVE classes — `TokenBucketFairness`,
+  `ClassPolicy`, `Autoscaler` from serve/control.py and `SLOTracker`
+  from obs/slo.py — driven through their injected `now_fn` clocks by
+  the event heap. There is no re-implementation to drift.
+* service times come from the replay-fitted cost model
+  (obs/replay.py `sim_tables`): prefill = a + b * prompt_tokens,
+  decode = flat per-token step (ITL is flat in occupancy — PERF.md
+  round 10), replica boot = AOT-store spin-up walls (round 22).
+* requests are simulated at REQUEST granularity (admit / first-token /
+  finish / preempt events, ~3-4 heap events per request), which is what
+  makes millions of requests tractable; token-level behaviour is
+  implied by the fitted step time.
+
+Three seeded A/B scenarios (`--ab`) mirror the acceptance criteria:
+
+* fairness  — one hot tenant at ~6x fair share vs four well-behaved
+  tenants, token-bucket fairness off vs on.
+* autoscale — a 10x Poisson ramp, fixed fleet vs forecast autoscaler.
+* preemption — mixed-class overload at 1.3x capacity with interactive
+  bursts, class policy + voluntary preemption off vs on.
+
+Every arm reports bootstrap confidence intervals (seeded resampling
+over reservoir-sampled TTFTs and per-second shed counts) so A/B deltas
+come with error bars, and `accept` booleans encode the claims.
+
+Determinism: a single `random.Random(seed)` stream per arm, no wall
+clock anywhere near the output, sorted-keys JSON. The same command line
+produces byte-identical output — tier-1 CI runs `--smoke --seed 0`
+twice and diffs the files.
+"""
+
+from __future__ import annotations
+
+import argparse
+import heapq
+import json
+import math
+import os
+import random
+import zlib
+from typing import Callable, Optional
+
+from distributed_pytorch_tpu.config import knob
+from distributed_pytorch_tpu.obs.replay import load_cost_model, sim_tables
+from distributed_pytorch_tpu.obs.slo import SLOTracker, default_targets
+from distributed_pytorch_tpu.serve.control import (
+    Autoscaler, ClassPolicy, FleetSample, TokenBucketFairness)
+
+# ----------------------------------------------------------------------
+# deterministic helpers
+# ----------------------------------------------------------------------
+
+
+def derive_seed(*parts) -> int:
+    """Stable sub-seed from string parts (crc32, NOT hash() — string
+    hashing is salted per process and would break the byte-diff gate)."""
+    return zlib.crc32("|".join(str(p) for p in parts).encode("utf-8"))
+
+
+class Reservoir:
+    """Classic reservoir sampler: a capped, uniformly-representative
+    sample of an unbounded observation stream, deterministic given the
+    rng and insertion order. Keeps percentile/bootstrap costs bounded
+    at millions of requests."""
+
+    def __init__(self, cap: int, rng: random.Random):
+        self.cap = cap
+        self.rng = rng
+        self.n = 0
+        self.buf: list[float] = []
+
+    def add(self, v: float) -> None:
+        self.n += 1
+        if len(self.buf) < self.cap:
+            self.buf.append(v)
+        else:
+            j = self.rng.randrange(self.n)
+            if j < self.cap:
+                self.buf[j] = v
+
+
+def pctl(sorted_vals: list[float], q: float) -> float:
+    """Linear-interpolated percentile of a pre-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    f = q * (len(sorted_vals) - 1)
+    lo = int(math.floor(f))
+    hi = min(len(sorted_vals) - 1, lo + 1)
+    return sorted_vals[lo] + (sorted_vals[hi] - sorted_vals[lo]) * (f - lo)
+
+
+def bootstrap_ci(samples: list, stat_fn: Callable[[list], float],
+                 n_boot: int, rng: random.Random,
+                 lo_q: float = 0.025, hi_q: float = 0.975
+                 ) -> tuple[float, float]:
+    """Percentile-bootstrap CI of `stat_fn` over `samples` (seeded
+    resampling with replacement)."""
+    if not samples:
+        return 0.0, 0.0
+    n = len(samples)
+    stats = sorted(
+        stat_fn([samples[rng.randrange(n)] for _ in range(n)])
+        for _ in range(n_boot))
+    return pctl(stats, lo_q), pctl(stats, hi_q)
+
+
+def ci_disjoint(a: tuple[float, float], b: tuple[float, float]) -> bool:
+    return a[1] < b[0] or b[1] < a[0]
+
+
+# ----------------------------------------------------------------------
+# simulated fleet
+# ----------------------------------------------------------------------
+
+#: per-class draw ranges (prompt tokens, decode budget) for synthetic
+#: traffic — interactive is short/chatty, batch is long-form.
+CLASS_SHAPES = {
+    "interactive": {"prompt": (32, 256), "budget": (16, 64)},
+    "batch": {"prompt": (128, 1024), "budget": (64, 256)},
+}
+
+
+class SimReq:
+    __slots__ = ("rid", "tenant", "cls", "slo_class", "prompt_len",
+                 "budget", "t_submit", "served", "resumed", "admitted_at",
+                 "epoch", "first_tok_t", "got_first", "cur_prefill_s",
+                 "preempts")
+
+    def __init__(self, rid, tenant, cls, slo_class, prompt_len, budget,
+                 t_submit):
+        self.rid = rid
+        self.tenant = tenant
+        self.cls = cls                 # true class (metrics)
+        self.slo_class = slo_class     # class the policy sees
+        self.prompt_len = prompt_len
+        self.budget = budget
+        self.t_submit = t_submit
+        self.served = 0
+        self.resumed = False
+        self.admitted_at = 0.0
+        self.epoch = 0
+        self.first_tok_t = 0.0
+        self.got_first = False
+        self.cur_prefill_s = 0.0
+        self.preempts = 0
+
+
+class SimReplica:
+    __slots__ = ("idx", "n_slots", "queue", "live", "state")
+
+    def __init__(self, idx: int, n_slots: int):
+        self.idx = idx
+        self.n_slots = n_slots
+        self.queue: list[SimReq] = []       # class-ordered (ClassPolicy)
+        self.live: dict[int, SimReq] = {}   # rid -> req
+        self.state = "serving"
+
+    @property
+    def load(self) -> int:
+        return len(self.live) + len(self.queue)
+
+
+def mean_service_s(tables: dict, p_interactive: float) -> float:
+    """Expected slot-seconds per request under the class mix — the
+    calibration constant that converts replica counts to capacity rps."""
+    step_s = tables["decode_step_ms"] / 1000.0
+    a_s = tables["prefill_a_ms"] / 1000.0
+    b_s = tables["prefill_b_ms_per_token"] / 1000.0
+    total = 0.0
+    for cls, p in (("interactive", p_interactive),
+                   ("batch", 1.0 - p_interactive)):
+        shape = CLASS_SHAPES[cls]
+        prompt = sum(shape["prompt"]) / 2.0
+        budget = sum(shape["budget"]) / 2.0
+        total += p * (a_s + b_s * prompt + budget * step_s)
+    return total
+
+
+def capacity_rps(tables: dict, n_replicas: int, n_slots: int,
+                 p_interactive: float) -> float:
+    return n_replicas * n_slots / mean_service_s(tables, p_interactive)
+
+
+class FleetSim:
+    """One simulated arm: a fleet of slot-limited replicas behind a
+    least-loaded dispatcher, Poisson arrivals, and the live policy
+    objects wired to the event-heap clock."""
+
+    TICK_S = 1.0          # autoscaler / SLO sampling cadence
+    PICK_SAMPLE = 16      # dispatcher scans this many replicas when the
+    #                       fleet is larger (best-of-k ~= least-loaded)
+
+    def __init__(self, *, tables: dict, seed: int, n_replicas: int,
+                 duration_s: float, lam_fn: Callable[[float], float],
+                 p_interactive_fn: Callable[[float], float],
+                 tenants: list[tuple[str, float]],
+                 n_slots: int = 8, max_queue: int = 64,
+                 fairness_rate: float = 0.0,
+                 fairness_burst: Optional[float] = None,
+                 class_policy: bool = True,
+                 autoscaler: Optional[Autoscaler] = None,
+                 boot_s: float = 2.0,
+                 reservoir_cap: int = 4000):
+        self.tables = tables
+        self.rng = random.Random(seed)
+        self.now = 0.0
+        self.duration_s = duration_s
+        self.lam_fn = lam_fn
+        self.p_int_fn = p_interactive_fn
+        self.n_slots = n_slots
+        self.max_queue = max_queue
+        self.class_policy = class_policy
+        self.boot_s = boot_s
+        self.step_s = tables["decode_step_ms"] / 1000.0
+        self.prefill_a_s = tables["prefill_a_ms"] / 1000.0
+        self.prefill_b_s = tables["prefill_b_ms_per_token"] / 1000.0
+
+        clock = lambda: self.now  # noqa: E731 — the injected sim clock
+        self.fairness = TokenBucketFairness(
+            rate_tokens_s=fairness_rate,
+            burst=fairness_burst if fairness_burst is not None
+            else max(1.0, fairness_rate * 2.0),
+            now_fn=clock)
+        self.autoscaler = autoscaler
+        self.slo = SLOTracker(targets=default_targets(),
+                              windows_s=(5.0, 30.0, 120.0), now_fn=clock)
+        self.slo_ttft_s = float(knob("SLO_TTFT_P99_S"))
+
+        self.reps = [SimReplica(i, n_slots) for i in range(n_replicas)]
+        self.serving: list[SimReplica] = list(self.reps)
+        self.n_booting = 0
+        self.start_replicas = n_replicas
+        self.peak_replicas = n_replicas
+        self.first_scale_up_t: Optional[float] = None
+
+        # tenant draw table
+        tot_w = sum(w for _, w in tenants)
+        acc = 0.0
+        self.tenant_cdf: list[tuple[float, str]] = []
+        for name, w in tenants:
+            acc += w / tot_w
+            self.tenant_cdf.append((acc, name))
+
+        # counters
+        self.arrivals = 0
+        self.completed = {"interactive": 0, "batch": 0}
+        self.shed = {}                        # cause -> n
+        self.shed_by_cls = {}                 # "cause|cls" -> n
+        self.preempted = 0
+        self.preempted_by_cls = {}            # cls -> n
+        self.preempted_then_shed = 0
+        self.resumed_completed = 0
+        self.tenant_stats = {name: {"offered": 0, "admitted": 0,
+                                    "rejected": 0, "completed": 0}
+                             for name, _ in tenants}
+        self.ttft_good = 0
+        self.ttft_total = 0
+        seconds = int(duration_s) + 2
+        self.arr_sec = [0] * seconds
+        self.shed_cap_sec = [0] * seconds     # queue_full only
+        self.max_queue_depth = 0
+        self.worst_burn_peak = 0.0
+
+        # reservoirs: TTFT per class, plus hot/other tenant split
+        self.res: dict[str, Reservoir] = {}
+        self.reservoir_cap = reservoir_cap
+
+        self.heap: list = []
+        self._seq = 0
+        self._rid = 0
+
+    # -- event plumbing -------------------------------------------------
+
+    def push(self, t: float, kind: str, a=None, b=None) -> None:
+        heapq.heappush(self.heap, (t, self._seq, kind, a, b))
+        self._seq += 1
+
+    def reservoir(self, key: str) -> Reservoir:
+        r = self.res.get(key)
+        if r is None:
+            r = self.res[key] = Reservoir(self.reservoir_cap, self.rng)
+        return r
+
+    def _sec(self, arr: list, t: float) -> int:
+        return min(len(arr) - 1, int(t))
+
+    # -- traffic --------------------------------------------------------
+
+    def _draw_tenant(self) -> str:
+        r = self.rng.random()
+        for edge, name in self.tenant_cdf:
+            if r <= edge:
+                return name
+        return self.tenant_cdf[-1][1]
+
+    def _draw_request(self) -> SimReq:
+        p_int = self.p_int_fn(self.now)
+        cls = "interactive" if self.rng.random() < p_int else "batch"
+        shape = CLASS_SHAPES[cls]
+        prompt = self.rng.randint(*shape["prompt"])
+        budget = self.rng.randint(*shape["budget"])
+        tenant = self._draw_tenant()
+        # with the class policy off (A/B control arm) everything runs
+        # as one FCFS class and nothing is preemptible
+        slo_class = cls if self.class_policy else "interactive"
+        self._rid += 1
+        return SimReq(self._rid, tenant, cls, slo_class, prompt, budget,
+                      self.now)
+
+    def _schedule_next_arrival(self) -> None:
+        lam = max(1e-9, self.lam_fn(self.now))
+        t = self.now + self.rng.expovariate(lam)
+        if t < self.duration_s:
+            self.push(t, "arrival")
+
+    def _record_shed(self, cause: str, req: SimReq) -> None:
+        self.shed[cause] = self.shed.get(cause, 0) + 1
+        k = f"{cause}|{req.cls}"
+        self.shed_by_cls[k] = self.shed_by_cls.get(k, 0) + 1
+        if cause == "queue_full":
+            self.shed_cap_sec[self._sec(self.shed_cap_sec, self.now)] += 1
+        if req.resumed:
+            self.preempted_then_shed += 1
+
+    def _on_arrival(self) -> None:
+        self._schedule_next_arrival()
+        req = self._draw_request()
+        self.arrivals += 1
+        self.arr_sec[self._sec(self.arr_sec, self.now)] += 1
+        ts = self.tenant_stats[req.tenant]
+        ts["offered"] += 1
+        # router edge: tenant fairness first — the LIVE policy object
+        if not self.fairness.admit(req.tenant):
+            ts["rejected"] += 1
+            self._record_shed("rate_limited", req)
+            return
+        ts["admitted"] += 1
+        rep = self._pick_replica()
+        if rep is None or len(rep.queue) >= self.max_queue:
+            self._record_shed("queue_full", req)
+            return
+        rep.queue.insert(
+            ClassPolicy.insert_index(rep.queue, req.slo_class), req)
+        if req.slo_class == "interactive":
+            self._maybe_preempt(rep)
+        self._drain(rep)
+
+    def _pick_replica(self) -> Optional[SimReplica]:
+        serving = self.serving
+        if not serving:
+            return None
+        if len(serving) <= self.PICK_SAMPLE:
+            cands = serving
+        else:
+            n = len(serving)
+            cands = [serving[self.rng.randrange(n)]
+                     for _ in range(self.PICK_SAMPLE)]
+        return min(cands, key=lambda r: (r.load, r.idx))
+
+    # -- replica mechanics ---------------------------------------------
+
+    def _drain(self, rep: SimReplica) -> None:
+        while rep.queue and len(rep.live) < rep.n_slots:
+            self._admit(rep, rep.queue.pop(0))
+
+    def _admit(self, rep: SimReplica, req: SimReq) -> None:
+        # resume is a radix/host-tier prefix hit: only the constant
+        # prefill term is paid again (PERF.md rounds 14/17)
+        prefill = (self.prefill_a_s if req.resumed
+                   else self.prefill_a_s + self.prefill_b_s
+                   * req.prompt_len)
+        req.cur_prefill_s = prefill
+        req.admitted_at = self.now
+        req.first_tok_t = self.now + prefill + self.step_s
+        remaining = req.budget - req.served
+        rep.live[req.rid] = req
+        self.push(self.now + prefill + remaining * self.step_s,
+                  "finish", rep.idx, (req.rid, req.epoch))
+
+    def _record_ttft(self, req: SimReq) -> None:
+        if req.got_first:
+            return
+        req.got_first = True
+        v = req.first_tok_t - req.t_submit
+        self.ttft_total += 1
+        if v <= self.slo_ttft_s:
+            self.ttft_good += 1
+        ms = v * 1000.0
+        self.reservoir(f"ttft|{req.cls}").add(ms)
+        self.reservoir(f"ttft_tenant|{req.tenant}").add(ms)
+
+    def _maybe_preempt(self, rep: SimReplica) -> None:
+        """Voluntary class preemption — the scheduler's policy calls,
+        verbatim, against the sim queue/live structures."""
+        if not self.class_policy:
+            return
+        free = rep.n_slots - len(rep.live)
+        n_int = ClassPolicy.queued_interactive(rep.queue)
+        live_batch = [r for r in rep.live.values()
+                      if r.slo_class == "batch"]
+        k = ClassPolicy.preempt_count(n_int, free, len(live_batch))
+        for victim in ClassPolicy.pick_victims(live_batch, k):
+            self._evict(rep, victim)
+
+    def _evict(self, rep: SimReplica, req: SimReq) -> None:
+        decoded = 0
+        t_decode = self.now - (req.admitted_at + req.cur_prefill_s)
+        if t_decode > 0:
+            remaining = req.budget - req.served
+            decoded = min(remaining - 1,
+                          int(t_decode / self.step_s) + 1)
+            decoded = max(0, decoded)
+        if decoded >= 1:
+            self._record_ttft(req)       # first token already streamed
+        req.served += decoded
+        req.epoch += 1                   # invalidates the finish event
+        del rep.live[req.rid]
+        req.resumed = True
+        req.preempts += 1
+        self.preempted += 1
+        self.preempted_by_cls[req.cls] = \
+            self.preempted_by_cls.get(req.cls, 0) + 1
+        rep.queue.insert(
+            ClassPolicy.insert_index(rep.queue, req.slo_class,
+                                     resumed=True), req)
+
+    def _on_finish(self, rep_idx: int, payload) -> None:
+        rid, epoch = payload
+        rep = self.reps[rep_idx]
+        req = rep.live.get(rid)
+        if req is None or req.epoch != epoch:
+            return                       # stale event (preempted)
+        del rep.live[rid]
+        self._record_ttft(req)
+        self.completed[req.cls] += 1
+        self.tenant_stats[req.tenant]["completed"] += 1
+        if req.preempts:
+            self.resumed_completed += 1
+        self._drain(rep)
+
+    # -- autoscaling ----------------------------------------------------
+
+    def _fleet_sample(self) -> FleetSample:
+        n = len(self.serving)
+        live = sum(len(r.live) for r in self.serving)
+        qdepth = sum(len(r.queue) for r in self.serving)
+        occ = live / max(1, n * self.n_slots)
+        shed_all = sum(self.shed.values())
+        shed_cap = shed_all - self.shed.get("rate_limited", 0)
+        recent = shed_cap - getattr(self, "_shed_seen", 0)
+        self._shed_seen = shed_cap
+        return FleetSample(t=self.now, n_replicas=n,
+                           n_booting=self.n_booting, occupancy=occ,
+                           queue_depth=qdepth,
+                           worst_burn=self.slo.worst_burn(),
+                           shed_recent=recent)
+
+    def _on_tick(self) -> None:
+        if self.now + self.TICK_S < self.duration_s:
+            self.push(self.now + self.TICK_S, "tick")
+        shed_all = sum(self.shed.values())
+        done = sum(self.completed.values())
+        self.slo.update({
+            "ttft_p99": (self.ttft_good, self.ttft_total),
+            "availability": (done, done + shed_all),
+        })
+        s = self._fleet_sample()
+        self.max_queue_depth = max(self.max_queue_depth, s.queue_depth)
+        self.worst_burn_peak = max(self.worst_burn_peak, s.worst_burn)
+        if self.autoscaler is None:
+            return
+        delta = self.autoscaler.decide(s)
+        if delta > 0:
+            if self.first_scale_up_t is None:
+                self.first_scale_up_t = self.now
+            for _ in range(delta):
+                self.n_booting += 1
+                self.push(self.now + self.boot_s, "boot")
+        elif delta < 0:
+            for rep in reversed(self.serving):
+                if not rep.live and not rep.queue:
+                    rep.state = "removed"
+                    self.serving.remove(rep)
+                    break
+
+    def _on_boot(self) -> None:
+        self.n_booting -= 1
+        rep = SimReplica(len(self.reps), self.n_slots)
+        self.reps.append(rep)
+        self.serving.append(rep)
+        self.peak_replicas = max(self.peak_replicas, len(self.serving))
+        self._drain(rep)
+
+    # -- main loop ------------------------------------------------------
+
+    def run(self) -> dict:
+        self.push(0.0, "tick")
+        self._schedule_next_arrival()
+        heap = self.heap
+        while heap:
+            t, _, kind, a, b = heapq.heappop(heap)
+            self.now = t
+            if kind == "arrival":
+                self._on_arrival()
+            elif kind == "finish":
+                self._on_finish(a, b)
+            elif kind == "tick":
+                self._on_tick()
+            elif kind == "boot":
+                self._on_boot()
+        return self.summary()
+
+    # -- reporting ------------------------------------------------------
+
+    def _ttft_summary(self, key: str, boot_rng: random.Random,
+                      n_boot: int) -> dict:
+        res = self.res.get(key)
+        if res is None or not res.buf:
+            return {"n": 0, "p50_ms": 0.0, "p99_ms": 0.0,
+                    "p99_ci_ms": [0.0, 0.0]}
+        buf = sorted(res.buf)
+        lo, hi = bootstrap_ci(res.buf, lambda s: pctl(sorted(s), 0.99),
+                              n_boot, boot_rng)
+        return {"n": res.n,
+                "p50_ms": round(pctl(buf, 0.50), 2),
+                "p99_ms": round(pctl(buf, 0.99), 2),
+                "p99_ci_ms": [round(lo, 2), round(hi, 2)]}
+
+    def shed_rate_ci(self, boot_rng: random.Random,
+                     n_boot: int) -> tuple[float, list[float]]:
+        """Capacity-shed rate (queue_full / arrivals) with a per-second
+        block-bootstrap CI — seconds are the resampling unit so the CI
+        respects the burstiness of the arrival process."""
+        pairs = [(s, a) for s, a in zip(self.shed_cap_sec, self.arr_sec)
+                 if a > 0]
+        total_arr = sum(a for _, a in pairs)
+        rate = (sum(s for s, _ in pairs) / total_arr) if total_arr else 0.0
+
+        def stat(sample):
+            arr = sum(a for _, a in sample)
+            return (sum(s for s, _ in sample) / arr) if arr else 0.0
+
+        lo, hi = bootstrap_ci(pairs, stat, n_boot, boot_rng)
+        return rate, [round(lo, 4), round(hi, 4)]
+
+    def summary(self, n_boot: int = 200) -> dict:
+        boot_rng = random.Random(derive_seed("bootstrap", self._rid,
+                                             self.arrivals))
+        done = sum(self.completed.values())
+        shed_all = sum(self.shed.values())
+        cap_rate, cap_ci = self.shed_rate_ci(boot_rng, n_boot)
+        out = {
+            "arrivals": self.arrivals,
+            "completed": dict(sorted(self.completed.items())),
+            "in_flight": self.arrivals - done - shed_all,
+            "shed": dict(sorted(self.shed.items())),
+            "shed_by_class": dict(sorted(self.shed_by_cls.items())),
+            "shed_rate": round(shed_all / max(1, self.arrivals), 4),
+            "capacity_shed_rate": round(cap_rate, 4),
+            "capacity_shed_rate_ci": cap_ci,
+            "preempted": self.preempted,
+            "preempted_by_class":
+                dict(sorted(self.preempted_by_cls.items())),
+            "preempted_then_shed": self.preempted_then_shed,
+            "resumed_completed": self.resumed_completed,
+            "ttft_ms": {cls: self._ttft_summary(f"ttft|{cls}",
+                                                boot_rng, n_boot)
+                        for cls in ("interactive", "batch")},
+            "tenants": {name: dict(st) for name, st in
+                        sorted(self.tenant_stats.items())},
+            "fairness": self.fairness.snapshot(),
+            "replicas": {
+                "start": self.start_replicas,
+                "peak": self.peak_replicas,
+                "final": len(self.serving),
+                "first_scale_up_t_s":
+                    (round(self.first_scale_up_t, 1)
+                     if self.first_scale_up_t is not None else None),
+                "scaled_up": (self.autoscaler.scaled_up
+                              if self.autoscaler else 0),
+                "scaled_down": (self.autoscaler.scaled_down
+                                if self.autoscaler else 0),
+            },
+            "max_queue_depth": self.max_queue_depth,
+            "worst_burn_peak": round(self.worst_burn_peak, 3),
+        }
+        # tenant-split TTFT (fairness scenario reads these)
+        for key in sorted(self.res):
+            if key.startswith("ttft_tenant|"):
+                out.setdefault("ttft_ms_by_tenant", {})[
+                    key.split("|", 1)[1]] = \
+                    self._ttft_summary(key, boot_rng, n_boot)
+        return out
+
+
+# ----------------------------------------------------------------------
+# scenarios (the A/B arms of the acceptance criteria)
+# ----------------------------------------------------------------------
+
+
+def scenario_fairness(tables: dict, seed: int, n_replicas: int,
+                      duration_s: float, reservoir_cap: int) -> dict:
+    """One hot tenant at 6x its fair share, four polite tenants;
+    fairness off vs on. Claim: the bucket caps the hot tenant while the
+    others' p99 TTFT stays within SLO."""
+    n_slots = 8
+    tenants = [("hot", 0.6), ("t1", 0.1), ("t2", 0.1), ("t3", 0.1),
+               ("t4", 0.1)]
+    cap = capacity_rps(tables, n_replicas, n_slots, 1.0)
+    offered = 1.5 * cap
+    fair_share = cap / len(tenants)
+    arms = {}
+    for arm, rate in (("fairness_off", 0.0), ("fairness_on", fair_share)):
+        sim = FleetSim(
+            tables=tables, seed=derive_seed(seed, "fairness", arm),
+            n_replicas=n_replicas, duration_s=duration_s,
+            lam_fn=lambda t: offered,
+            p_interactive_fn=lambda t: 1.0,   # single class: isolate
+            tenants=tenants, n_slots=n_slots,  # fairness from classes
+            fairness_rate=rate, fairness_burst=max(1.0, rate * 0.5),
+            reservoir_cap=reservoir_cap)
+        arms[arm] = sim.run()
+
+    def others_p99(arm):
+        per_t = arms[arm].get("ttft_ms_by_tenant", {})
+        vals = [per_t[t]["p99_ms"] for t in ("t1", "t2", "t3", "t4")
+                if t in per_t]
+        cis = [per_t[t]["p99_ci_ms"] for t in ("t1", "t2", "t3", "t4")
+               if t in per_t]
+        if not vals:
+            return 0.0, (0.0, 0.0)
+        worst = max(range(len(vals)), key=lambda i: vals[i])
+        return vals[worst], tuple(cis[worst])
+
+    slo_ms = float(knob("SLO_TTFT_P99_S")) * 1000.0
+    off_p99, off_ci = others_p99("fairness_off")
+    on_p99, on_ci = others_p99("fairness_on")
+    hot = arms["fairness_on"]["tenants"]["hot"]
+    hot_admit_rps = hot["admitted"] / duration_s
+    return {
+        "offered_rps": round(offered, 1),
+        "capacity_rps": round(cap, 1),
+        "fair_share_rps": round(fair_share, 1),
+        "arms": arms,
+        "others_worst_p99_ms": {"fairness_off": off_p99,
+                                "fairness_on": on_p99},
+        "accept": {
+            "hot_tenant_capped": hot_admit_rps <= fair_share * 1.1,
+            "others_slo_held": on_p99 <= slo_ms,
+            "ci_disjoint_others_p99": ci_disjoint(on_ci, off_ci),
+        },
+    }
+
+
+def scenario_autoscale(tables: dict, seed: int, n_replicas: int,
+                       duration_s: float, reservoir_cap: int) -> dict:
+    """A 10x linear ramp against a fixed fleet vs the forecast
+    autoscaler. Claim: the fixed fleet sheds >20%, the autoscaler keeps
+    shed ~0 by scaling BEFORE the knee."""
+    n_slots = 8
+    n0 = max(4, n_replicas // 10)
+    cap0 = capacity_rps(tables, n0, n_slots, 0.5)
+    lam0 = 0.6 * cap0
+
+    def lam_fn(t):
+        return lam0 * (1.0 + 9.0 * min(1.0, t / duration_s))
+
+    boot_s = tables.get("boot_s", 2.0)
+    arms = {}
+    for arm in ("autoscale_off", "autoscale_on"):
+        scaler = None
+        if arm == "autoscale_on":
+            scaler = Autoscaler(min_replicas=n0, max_replicas=n_replicas,
+                                lead_s=15.0, cooldown_s=2.0,
+                                slope_window_s=30.0)
+        sim = FleetSim(
+            tables=tables, seed=derive_seed(seed, "autoscale", arm),
+            n_replicas=n0, duration_s=duration_s, lam_fn=lam_fn,
+            p_interactive_fn=lambda t: 0.5,
+            tenants=[("t0", 1.0)], n_slots=n_slots,
+            autoscaler=scaler, boot_s=boot_s,
+            reservoir_cap=reservoir_cap)
+        arms[arm] = sim.run()
+    off, on = arms["autoscale_off"], arms["autoscale_on"]
+    knee = float(knob("AUTOSCALE_KNEE_OCCUPANCY"))
+    # the time the OFF fleet first sheds is when demand crossed the
+    # knee at fixed capacity; scaling must have started before that
+    first_up = on["replicas"]["first_scale_up_t_s"]
+    # demand(t)/cap0 > knee  =>  t* from the linear ramp
+    t_knee = duration_s * (knee * cap0 / lam0 - 1.0) / 9.0
+    return {
+        "start_replicas": n0,
+        "max_replicas": n_replicas,
+        "ramp": "10x linear",
+        "boot_s": boot_s,
+        "t_knee_s": round(t_knee, 1),
+        "arms": arms,
+        "accept": {
+            "off_shed_gt_20pct": off["capacity_shed_rate"] > 0.20,
+            "on_shed_near_zero": on["capacity_shed_rate"] < 0.01,
+            "ci_disjoint_shed_rate": ci_disjoint(
+                tuple(on["capacity_shed_rate_ci"]),
+                tuple(off["capacity_shed_rate_ci"])),
+            "scaled_before_knee": (first_up is not None
+                                   and first_up < t_knee),
+        },
+    }
+
+
+def scenario_preemption(tables: dict, seed: int, n_replicas: int,
+                        duration_s: float, reservoir_cap: int) -> dict:
+    """Mixed-class overload at 1.3x capacity with interactive bursts;
+    class policy + voluntary preemption off vs on. Claim: preemption
+    holds interactive p99 TTFT within SLO while batch absorbs every
+    preemption and no started batch stream is lost."""
+    n_slots = 8
+    cap = capacity_rps(tables, n_replicas, n_slots, 0.5)
+    offered = 1.3 * cap
+
+    def p_int_fn(t):
+        # interactive share oscillates 0.2..0.8 (20 s period): the
+        # bursts are what forces slot contention and preemption
+        return 0.5 + 0.3 * math.sin(2.0 * math.pi * t / 20.0)
+
+    arms = {}
+    for arm, on in (("preempt_off", False), ("preempt_on", True)):
+        sim = FleetSim(
+            tables=tables, seed=derive_seed(seed, "preempt", arm),
+            n_replicas=n_replicas, duration_s=duration_s,
+            lam_fn=lambda t: offered, p_interactive_fn=p_int_fn,
+            tenants=[("t0", 1.0)], n_slots=n_slots,
+            class_policy=on, reservoir_cap=reservoir_cap)
+        arms[arm] = sim.run()
+    off, on_ = arms["preempt_off"], arms["preempt_on"]
+    slo_ms = float(knob("SLO_TTFT_P99_S")) * 1000.0
+    on_int = on_["ttft_ms"]["interactive"]
+    off_int = off["ttft_ms"]["interactive"]
+    return {
+        "offered_rps": round(offered, 1),
+        "capacity_rps": round(cap, 1),
+        "arms": arms,
+        "accept": {
+            "interactive_slo_held": on_int["p99_ms"] <= slo_ms,
+            "batch_zero_lost": on_["preempted_then_shed"] == 0,
+            "batch_absorbs_all_preemptions":
+                on_["preempted_by_class"].get("interactive", 0) == 0,
+            "ci_disjoint_interactive_p99": ci_disjoint(
+                tuple(on_int["p99_ci_ms"]), tuple(off_int["p99_ci_ms"])),
+        },
+    }
+
+
+SCENARIOS = {
+    "fairness": scenario_fairness,
+    "autoscale": scenario_autoscale,
+    "preemption": scenario_preemption,
+}
+
+
+# ----------------------------------------------------------------------
+# CLI
+# ----------------------------------------------------------------------
+
+
+def resolve_tables(cost_model_path: Optional[str]) -> dict:
+    cm = None
+    if cost_model_path and os.path.exists(cost_model_path):
+        cm = load_cost_model(cost_model_path)
+    return sim_tables(cm)
+
+
+def run_report(*, seed: int, n_replicas: int, duration_s: float,
+               cost_model: Optional[str], smoke: bool,
+               scenarios: Optional[list[str]] = None) -> dict:
+    tables = resolve_tables(cost_model)
+    reservoir_cap = 500 if smoke else 4000
+    report = {
+        "meta": {
+            "mode": "smoke" if smoke else "ab",
+            "seed": seed,
+            "replicas": n_replicas,
+            "duration_s": duration_s,
+            "tables": {k: tables[k] for k in sorted(tables)},
+            "policies": ["ClassPolicy", "TokenBucketFairness",
+                         "Autoscaler", "SLOTracker"],
+            "version": 1,
+        },
+        "scenarios": {},
+    }
+    for name in (scenarios or sorted(SCENARIOS)):
+        report["scenarios"][name] = SCENARIOS[name](
+            tables, seed, n_replicas, duration_s, reservoir_cap)
+    report["accept"] = {
+        f"{name}.{k}": v
+        for name, sc in sorted(report["scenarios"].items())
+        for k, v in sorted(sc["accept"].items())}
+    return report
+
+
+def build_args() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="python -m sim.fleetsim",
+        description="seeded discrete-event fleet simulator for the "
+                    "serving control plane (policy A/Bs with bootstrap "
+                    "CIs; byte-deterministic under --seed)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny deterministic run (CI gate: run twice, "
+                         "diff bytes)")
+    ap.add_argument("--ab", action="store_true",
+                    help="full policy A/B at --replicas scale")
+    ap.add_argument("--seed", type=int, default=None,
+                    help=f"rng seed (default: SIM_SEED knob = "
+                         f"{knob('SIM_SEED')})")
+    ap.add_argument("--replicas", type=int, default=None,
+                    help=f"simulated fleet size (default: SIM_REPLICAS "
+                         f"knob = {knob('SIM_REPLICAS')})")
+    ap.add_argument("--duration", type=float, default=None,
+                    help=f"simulated seconds per arm (default: "
+                         f"SIM_DURATION_S knob = "
+                         f"{knob('SIM_DURATION_S')})")
+    ap.add_argument("--scenario", action="append",
+                    choices=sorted(SCENARIOS),
+                    help="run only this scenario (repeatable)")
+    ap.add_argument("--cost-model", default="runs/replay/cost_model.json",
+                    help="replay-fitted cost model json; falls back to "
+                         "built-in default tables when absent")
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON report to this path")
+    return ap
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    args = build_args().parse_args(argv)
+    seed = args.seed if args.seed is not None else int(knob("SIM_SEED"))
+    if args.smoke:
+        n_replicas = args.replicas or 10
+        duration_s = args.duration or 10.0
+    else:
+        n_replicas = (args.replicas if args.replicas is not None
+                      else int(knob("SIM_REPLICAS")))
+        duration_s = (args.duration if args.duration is not None
+                      else float(knob("SIM_DURATION_S")))
+    report = run_report(seed=seed, n_replicas=n_replicas,
+                        duration_s=duration_s,
+                        cost_model=args.cost_model, smoke=args.smoke,
+                        scenarios=args.scenario)
+    text = json.dumps(report, sort_keys=True, indent=2) + "\n"
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+    print(text, end="")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
